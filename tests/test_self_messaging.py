@@ -1,0 +1,78 @@
+"""An actor with only self-references and in-flight self-messages must not
+terminate until its queue drains.
+
+Analogue of the reference's SelfMessagingSpec (reference:
+src/test/scala/edu/illinois/osl/uigc/SelfMessagingSpec.scala:22-34).
+"""
+
+from uigc_tpu import AbstractBehavior, ActorTestKit, Behaviors, NoRefs, PostStop
+
+CONFIG = {"uigc.crgc.wakeup-interval": 10}
+
+
+class SelfRefTestInit(NoRefs):
+    def __init__(self, n):
+        self.n = n
+
+
+class Countdown(NoRefs):
+    def __init__(self, n):
+        self.n = n
+
+
+class SelfRefTerminated(NoRefs):
+    def __init__(self, n):
+        self.n = n
+
+    def __eq__(self, other):
+        return isinstance(other, SelfRefTerminated) and other.n == self.n
+
+    def __hash__(self):
+        return hash(("SelfRefTerminated", self.n))
+
+
+class ActorB(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        self.count = 0
+
+    def on_message(self, msg):
+        if isinstance(msg, Countdown) and msg.n > 0:
+            self.context.self.tell(Countdown(msg.n - 1), self.context)
+            self.count += 1
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(SelfRefTerminated(self.count))
+        return None
+
+
+class ActorA(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.actor_b = context.spawn(
+            Behaviors.setup(lambda ctx: ActorB(ctx, probe)), "actorB"
+        )
+
+    def on_message(self, msg):
+        if isinstance(msg, SelfRefTestInit):
+            self.actor_b.tell(Countdown(msg.n), self.context)
+            self.context.release(self.actor_b)
+        return self
+
+
+def test_no_premature_termination_with_self_messages():
+    kit = ActorTestKit(CONFIG)
+    try:
+        probe = kit.create_test_probe(timeout_s=30.0)
+        actor_a = kit.spawn(
+            Behaviors.setup_root(lambda ctx: ActorA(ctx, probe)), "actorA"
+        )
+        n = 10000
+        actor_a.tell(SelfRefTestInit(n))
+        # B must process all n countdowns before being collected.
+        probe.expect_message(SelfRefTerminated(n))
+    finally:
+        kit.shutdown()

@@ -1,0 +1,161 @@
+"""Parents must not be collected before the children they supervise.
+
+Analogue of the reference's SupervisionSpec (reference:
+src/test/scala/edu/illinois/osl/uigc/SupervisionSpec.scala, GH issue #15):
+the trace marks supervisors of live actors so stopping a parent can never
+take down a live child (reference: ShadowGraph.java:242-267).
+"""
+
+from uigc_tpu import AbstractBehavior, ActorTestKit, Behaviors, Message, NoRefs, PostStop
+
+CONFIG = {"uigc.crgc.wakeup-interval": 10}
+
+
+class Init(NoRefs):
+    pass
+
+
+class Initialized(NoRefs):
+    def __eq__(self, other):
+        return isinstance(other, Initialized)
+
+    def __hash__(self):
+        return hash("Initialized")
+
+
+class ReleaseParent(NoRefs):
+    pass
+
+
+class ReleaseChild1(NoRefs):
+    pass
+
+
+class ReleaseChild2(NoRefs):
+    pass
+
+
+class Spawned(NoRefs):
+    def __init__(self, name):
+        self.name = name
+
+
+class Terminated(NoRefs):
+    def __init__(self, name):
+        self.name = name
+
+    def __eq__(self, other):
+        return isinstance(other, Terminated) and other.name == self.name
+
+    def __hash__(self):
+        return hash(("Terminated", self.name))
+
+
+class GetRef(Message):
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,)
+
+
+class Child(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        probe.ref.tell(Spawned(context.name))
+
+    def on_message(self, msg):
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(Terminated(self.context.name))
+        return None
+
+
+class Parent(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        probe.ref.tell(Spawned(context.name))
+        self.child1 = context.spawn(
+            Behaviors.setup(lambda ctx: Child(ctx, probe)), "child1"
+        )
+        self.child2 = context.spawn(
+            Behaviors.setup(lambda ctx: Child(ctx, probe)), "child2"
+        )
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, GetRef):
+            root = msg.ref
+            root.tell(GetRef(ctx.create_ref(self.child1, root)), ctx)
+            root.tell(GetRef(ctx.create_ref(self.child2, root)), ctx)
+            ctx.release(self.child1, self.child2)
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(Terminated(self.context.name))
+        return None
+
+
+class RootActor(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        self.parent = None
+        self.child1 = None
+        self.child2 = None
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, Init):
+            self.parent = ctx.spawn(
+                Behaviors.setup(lambda c: Parent(c, self.probe)), "parent"
+            )
+            self.parent.tell(GetRef(ctx.create_ref(ctx.self, self.parent)), ctx)
+        elif isinstance(msg, GetRef):
+            if self.child1 is None:
+                self.child1 = msg.ref
+            else:
+                self.child2 = msg.ref
+                self.probe.ref.tell(Initialized())
+        elif isinstance(msg, ReleaseParent):
+            ctx.release(self.parent)
+        elif isinstance(msg, ReleaseChild1):
+            ctx.release(self.child1)
+        elif isinstance(msg, ReleaseChild2):
+            ctx.release(self.child2)
+        return self
+
+
+def test_supervision_ordering():
+    kit = ActorTestKit(CONFIG)
+    try:
+        probe = kit.create_test_probe()
+        root = kit.spawn(
+            Behaviors.setup_root(lambda ctx: RootActor(ctx, probe)), "root"
+        )
+        root.tell(Init())
+        parent = probe.expect_message_type(Spawned).name
+        child1 = probe.expect_message_type(Spawned).name
+        child2 = probe.expect_message_type(Spawned).name
+        probe.expect_message(Initialized())
+
+        # Parent is not collected while its children are alive.
+        root.tell(ReleaseParent())
+        probe.expect_no_message(0.3)
+
+        # Releasing one child collects only that child.
+        root.tell(ReleaseChild1())
+        probe.expect_message(Terminated(child1))
+
+        # Releasing the last child collects child and then parent.
+        root.tell(ReleaseChild2())
+        probe.expect_message(Terminated(child2))
+        probe.expect_message(Terminated(parent))
+    finally:
+        kit.shutdown()

@@ -1,0 +1,50 @@
+"""uigc-tpu: a TPU-native actor garbage-collection framework.
+
+A from-scratch re-design of the capability of ``dplyukhin/uigc-akka``
+(automatic detection and termination of quiescent actors) with a
+JAX/XLA/Pallas execution backend: per-actor snapshots are batched onto a
+device-resident shadow graph (CSR adjacency + node features) and the
+liveness trace runs as a sparse label-propagation-to-fixpoint kernel.
+
+Public API mirrors the reference's ``edu.illinois.osl.uigc`` surface:
+``ActorSystem``, ``ActorContext``, ``Behaviors``, ``AbstractBehavior``,
+``Message``/``NoRefs``, pluggable engines behind the ``uigc.engine``
+config key.
+"""
+
+from .config import Config
+from .interfaces import GCMessage, Message, NoRefs, Refob, SpawnInfo, State
+from .runtime.behaviors import AbstractBehavior, ActorFactory, Behaviors, RawBehavior
+from .runtime.context import ActorContext
+from .runtime.signals import PostStop, Signal, Terminated
+from .runtime.system import ActorSystem, RawRef
+from .runtime.testkit import ActorTestKit, TestProbe
+
+#: The reference calls managed refs ``ActorRef[T] = Refob[T]``
+#: (reference: package.scala:7-9).
+ActorRef = Refob
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AbstractBehavior",
+    "ActorContext",
+    "ActorFactory",
+    "ActorRef",
+    "ActorSystem",
+    "ActorTestKit",
+    "Behaviors",
+    "Config",
+    "GCMessage",
+    "Message",
+    "NoRefs",
+    "PostStop",
+    "RawBehavior",
+    "RawRef",
+    "Refob",
+    "Signal",
+    "SpawnInfo",
+    "State",
+    "TestProbe",
+    "__version__",
+]

@@ -1,0 +1,97 @@
+"""Configuration system for uigc-tpu.
+
+Mirrors the reference's Typesafe-Config keys (reference: src/main/resources/
+reference.conf:15-51) so users of the reference can carry their settings
+over unchanged.  Keys are dotted strings; defaults below correspond
+one-to-one with the reference defaults, plus TPU-specific additions under
+``uigc.crgc.shadow-graph`` and ``uigc.runtime``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+DEFAULTS: Dict[str, Any] = {
+    # Which GC engine to use. May be "crgc" (alias "tpu-crgc"), "mac",
+    # "manual", or "drl".  (reference: reference.conf:16-20, UIGC.scala:12-19)
+    "uigc.engine": "crgc",
+    # --- CRGC engine settings (reference: reference.conf:22-41) ---
+    # How actors are reminded to send an entry: "on-idle", "on-block" or
+    # "wave".  (reference: reference.conf:27-33)
+    "uigc.crgc.collection-style": "on-block",
+    # Milliseconds between GC control waves (wave style only).
+    "uigc.crgc.wave-frequency": 50,
+    # Maximum number of nodes in the cluster; GC is gated on full membership.
+    # (reference: GUIDE.md:44-47, LocalGC.scala:53,69-75)
+    "uigc.crgc.num-nodes": 1,
+    # Batch capacity of a cross-node delta graph, in shadows.
+    "uigc.crgc.delta-graph-size": 64,
+    # Capacity of each per-actor entry field (created/spawned/updated arrays).
+    "uigc.crgc.entry-field-size": 4,
+    # Milliseconds between collector (Bookkeeper) wakeups.
+    # (reference: LocalGC.scala:213 hard-codes 50ms; we make it a knob.)
+    "uigc.crgc.wakeup-interval": 50,
+    # Milliseconds between egress-entry finalizations (multi-node only).
+    # (reference: LocalGC.scala:219-224 hard-codes 10ms.)
+    "uigc.crgc.egress-finalize-interval": 10,
+    # Which shadow-graph implementation the collector uses:
+    #   "oracle" - pointer-based graph mirroring the JVM semantics exactly
+    #   "array"  - dense-array graph folded on host (numpy)
+    #   "device" - dense-array graph with the trace run on the TPU via JAX
+    "uigc.crgc.shadow-graph": "oracle",
+    # --- MAC engine settings (reference: reference.conf:43-50) ---
+    "uigc.mac.cycle-detection": False,
+    # Milliseconds between cycle-detector wakeups (reference:
+    # CycleDetector.scala:48 hard-codes 50ms).
+    "uigc.mac.wakeup-interval": 50,
+    # Whether the cycle detector actually collects cycles.  The reference's
+    # detector is a stub (reference.conf:48); ours implements SCC-based
+    # detection and this flag gates the kill decision.
+    "uigc.mac.collect-cycles": True,
+    # --- Host runtime settings (no reference analogue; ours) ---
+    # Number of dispatcher worker threads.
+    "uigc.runtime.num-workers": 4,
+    # Maximum messages an actor processes per scheduling slot (Akka calls
+    # this dispatcher "throughput").
+    "uigc.runtime.throughput": 16,
+}
+
+
+class Config:
+    """Immutable dotted-key configuration with reference-compatible defaults."""
+
+    def __init__(self, overrides: Optional[Mapping[str, Any]] = None):
+        self._data: Dict[str, Any] = dict(DEFAULTS)
+        if overrides:
+            for key, value in overrides.items():
+                self._data[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._data:
+            return self._data[key]
+        if default is not None:
+            return default
+        raise KeyError(f"unknown config key: {key}")
+
+    def get_int(self, key: str) -> int:
+        return int(self.get(key))
+
+    def get_bool(self, key: str) -> bool:
+        value = self.get(key)
+        if isinstance(value, str):
+            return value.lower() in ("on", "true", "yes", "1")
+        return bool(value)
+
+    def get_string(self, key: str) -> str:
+        return str(self.get(key))
+
+    def with_overrides(self, overrides: Mapping[str, Any]) -> "Config":
+        merged = dict(self._data)
+        merged.update(overrides)
+        return Config(merged)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._data
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Config({self._data!r})"

@@ -1,0 +1,110 @@
+"""The per-node collector actor ("Bookkeeper").
+
+Mirrors the reference's ``LocalGC`` (reference: crgc/LocalGC.scala:48-282):
+a system actor on a pinned thread that periodically drains the mutator
+entry queue, folds entries into its shadow graph, and runs the liveness
+trace.  Multi-node concerns (delta broadcast, ingress entries, undo logs,
+membership gating) are layered on in ``fabric``-aware subclasses/methods.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+from ...runtime.behaviors import RawBehavior
+from ...utils import events
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .engine import CRGC
+
+
+class _Wakeup:
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "Wakeup"
+
+
+class _StartWave:
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "StartWave"
+
+
+WAKEUP = _Wakeup()
+START_WAVE = _StartWave()
+
+
+class Bookkeeper(RawBehavior):
+    """Single-node collector loop (reference: LocalGC.scala:144-189)."""
+
+    def __init__(self, engine: "CRGC"):
+        self.engine = engine
+        self.cell: Any = None
+        self.total_entries = 0
+        self._timer_keys: list = []
+        self.shadow_graph = engine.make_shadow_graph()
+
+    # Bound by spawn_system_raw before the first batch runs.
+    def bind(self, cell: Any) -> None:
+        self.cell = cell
+        self.start()
+
+    def start(self) -> None:
+        """Begin periodic collection (reference: LocalGC.scala:211-226).
+        Single-node systems start immediately; multi-node systems call this
+        once membership is complete."""
+        timers = self.engine.system.timers
+        wakeup_s = self.engine.wakeup_interval_ms / 1000.0
+        key = ("crgc-wakeup", id(self))
+        self._timer_keys.append(key)
+        timers.schedule_fixed_delay(wakeup_s, lambda: self.cell.tell(WAKEUP), key=key)
+        if self.engine.collection_style == "wave":
+            wave_s = self.engine.wave_frequency_ms / 1000.0
+            key = ("crgc-wave", id(self))
+            self._timer_keys.append(key)
+            timers.schedule_fixed_delay(
+                wave_s, lambda: self.cell.tell(START_WAVE), key=key
+            )
+
+    def on_message(self, msg: Any) -> Any:
+        if isinstance(msg, _Wakeup):
+            self.collect()
+        elif isinstance(msg, _StartWave):
+            self.shadow_graph.start_wave()
+        return None
+
+    def collect(self) -> int:
+        """One collection pass: drain, fold, trace
+        (reference: LocalGC.scala:144-185)."""
+        engine = self.engine
+        queue = engine.queue
+        pool = engine.entry_pool
+        count = 0
+        with events.recorder.timed(events.PROCESSING_ENTRIES) as ev:
+            while True:
+                try:
+                    entry = queue.popleft()
+                except IndexError:
+                    break
+                count += 1
+                self.shadow_graph.merge_entry(entry)
+                entry.clean()
+                pool.append(entry)
+            ev.fields["num_entries"] = count
+        self.total_entries += count
+        self.shadow_graph.trace(should_kill=True)
+        return count
+
+    def stop_timers(self) -> None:
+        for key in self._timer_keys:
+            self.engine.system.timers.cancel(key)
+        self._timer_keys.clear()
+
+    def on_signal(self, signal: Any) -> Any:
+        from ...runtime.signals import _PostStop
+
+        if isinstance(signal, _PostStop):
+            self.stop_timers()
+        return None

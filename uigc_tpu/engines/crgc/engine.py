@@ -1,0 +1,229 @@
+"""The CRGC engine: conflict-replicated garbage collection.
+
+Mirrors the reference's default engine (reference: crgc/CRGC.scala:16-242):
+every managed actor continuously records local facts into a bounded
+``CrgcState``; snapshots flush through a shared queue to the per-node
+Bookkeeper; capacity or saturation forces early flushes.  Detection
+requires no message ordering and tolerates drops and downed nodes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import TYPE_CHECKING, Any, Callable, Iterable, Optional
+
+from ...interfaces import GCMessage, Refob, SpawnInfo
+from ...utils import events
+from ..engine import Engine, TerminationDecision
+from .collector import Bookkeeper
+from .messages import AppMsg, StopMsg, WaveMsg, _StopMsg, _WaveMsg
+from .refob import CrgcRefob
+from .state import CrgcContext, CrgcState, Entry
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ...runtime.cell import ActorCell
+    from ...runtime.context import ActorContext
+    from ...runtime.system import ActorSystem
+
+
+class CrgcSpawnInfo(SpawnInfo):
+    """(reference: CRGC.scala:22-24)"""
+
+    __slots__ = ("creator",)
+
+    def __init__(self, creator: Optional[CrgcRefob]):
+        self.creator = creator
+
+
+class CRGC(Engine):
+    """(reference: crgc/CRGC.scala:34-242)"""
+
+    def __init__(self, system: "ActorSystem"):
+        super().__init__(system)
+        config = system.config
+        self.collection_style: str = config.get_string("uigc.crgc.collection-style")
+        if self.collection_style not in ("on-idle", "on-block", "wave"):
+            raise ValueError(f"bad collection-style {self.collection_style!r}")
+        self.crgc_context = CrgcContext(
+            delta_graph_size=config.get_int("uigc.crgc.delta-graph-size"),
+            entry_field_size=config.get_int("uigc.crgc.entry-field-size"),
+        )
+        self.num_nodes = config.get_int("uigc.crgc.num-nodes")
+        self.wakeup_interval_ms = config.get_int("uigc.crgc.wakeup-interval")
+        self.wave_frequency_ms = config.get_int("uigc.crgc.wave-frequency")
+        self.shadow_graph_impl = config.get_string("uigc.crgc.shadow-graph")
+
+        # Mutator->collector channel + entry free list.  CPython deque
+        # append/popleft are atomic, giving the lock-free MPSC hand-off the
+        # reference gets from ConcurrentLinkedQueue (CRGC.scala:18,52).
+        self.queue: deque = deque()
+        self.entry_pool: deque = deque()
+
+        self.bookkeeper = self.make_bookkeeper()
+        self.bookkeeper_cell = system.spawn_system_raw(
+            self.bookkeeper, "Bookkeeper", pinned=True
+        )
+
+    # Factory hooks so the multi-node engine can substitute richer parts.
+
+    def make_bookkeeper(self) -> Bookkeeper:
+        return Bookkeeper(self)
+
+    def make_shadow_graph(self) -> Any:
+        if self.shadow_graph_impl == "oracle":
+            from .shadow import ShadowGraph
+
+            return ShadowGraph(self.crgc_context, self.system.address)
+        elif self.shadow_graph_impl in ("array", "device"):
+            from .arrays import ArrayShadowGraph
+
+            return ArrayShadowGraph(
+                self.crgc_context,
+                self.system.address,
+                use_device=(self.shadow_graph_impl == "device"),
+            )
+        raise ValueError(f"bad shadow-graph impl {self.shadow_graph_impl!r}")
+
+    # ----------------------------------------------------------------- #
+    # Root support
+    # ----------------------------------------------------------------- #
+
+    def root_message(self, payload: Any, refs: Iterable[Refob]) -> GCMessage:
+        return AppMsg(payload, refs)
+
+    def root_spawn_info(self) -> SpawnInfo:
+        return CrgcSpawnInfo(creator=None)
+
+    def to_root_refob(self, cell: "ActorCell") -> Refob:
+        return CrgcRefob(cell)
+
+    # ----------------------------------------------------------------- #
+    # Lifecycle
+    # ----------------------------------------------------------------- #
+
+    def init_state(self, cell: "ActorCell", spawn_info: CrgcSpawnInfo) -> CrgcState:
+        """(reference: CRGC.scala:69-92)"""
+        self_refob = CrgcRefob(cell)
+        state = CrgcState(self_refob, self.crgc_context)
+        state.record_new_refob(self_refob, self_refob)
+        if spawn_info.creator is not None:
+            state.record_new_refob(spawn_info.creator, self_refob)
+        else:
+            state.mark_as_root()
+
+        if self.collection_style == "on-block":
+            cell.on_finished_processing = lambda: self.send_entry(state, is_busy=False)
+        if (self.collection_style == "wave" and state.is_root) or (
+            self.collection_style == "on-idle"
+        ):
+            self.send_entry(state, is_busy=False)
+        return state
+
+    def get_self_ref(self, state: CrgcState, cell: "ActorCell") -> Refob:
+        return state.self_ref
+
+    def spawn(
+        self,
+        factory: Callable[[SpawnInfo], "ActorCell"],
+        state: CrgcState,
+        ctx: "ActorContext",
+    ) -> Refob:
+        """(reference: CRGC.scala:100-112)"""
+        child = factory(CrgcSpawnInfo(creator=state.self_ref))
+        ref = CrgcRefob(child)
+        # "onCreate" is only recorded at the child, not the parent.
+        if not state.can_record_new_actor():
+            self.send_entry(state, is_busy=True)
+        state.record_new_actor(ref)
+        return ref
+
+    # ----------------------------------------------------------------- #
+    # Message path
+    # ----------------------------------------------------------------- #
+
+    def send_message(
+        self,
+        ref: CrgcRefob,
+        msg: Any,
+        refs: Iterable[Refob],
+        state: CrgcState,
+        ctx: "ActorContext",
+    ) -> None:
+        """(reference: CRGC.scala:208-221)"""
+        if not ref.can_inc_send_count() or not state.can_record_updated_refob(ref):
+            self.send_entry(state, is_busy=True)
+        ref.inc_send_count()
+        state.record_updated_refob(ref)
+        ref.target.tell(AppMsg(msg, refs))
+
+    def on_message(
+        self, msg: GCMessage, state: CrgcState, ctx: "ActorContext"
+    ) -> Optional[Any]:
+        """(reference: CRGC.scala:114-127)"""
+        if isinstance(msg, AppMsg):
+            if not state.can_record_message_received():
+                self.send_entry(state, is_busy=True)
+            state.record_message_received()
+            return msg.payload
+        return None
+
+    def on_idle(
+        self, msg: GCMessage, state: CrgcState, ctx: "ActorContext"
+    ) -> TerminationDecision:
+        """(reference: CRGC.scala:129-149)"""
+        if isinstance(msg, _StopMsg):
+            return TerminationDecision.SHOULD_STOP
+        if isinstance(msg, _WaveMsg):
+            self.send_entry(state, is_busy=False)
+            for child in ctx.children:
+                child.tell(WaveMsg)
+            return TerminationDecision.SHOULD_CONTINUE
+        if self.collection_style == "on-idle":
+            self.send_entry(state, is_busy=False)
+        return TerminationDecision.SHOULD_CONTINUE
+
+    # ----------------------------------------------------------------- #
+    # Reference management
+    # ----------------------------------------------------------------- #
+
+    def create_ref(
+        self, target: CrgcRefob, owner: Refob, state: CrgcState, ctx: "ActorContext"
+    ) -> Refob:
+        """(reference: CRGC.scala:151-162)"""
+        ref = CrgcRefob(target.target, target.target_shadow)
+        if not state.can_record_new_refob():
+            self.send_entry(state, is_busy=True)
+        state.record_new_refob(owner, target)
+        return ref
+
+    def release(
+        self, releasing: Iterable[CrgcRefob], state: CrgcState, ctx: "ActorContext"
+    ) -> None:
+        """(reference: CRGC.scala:164-177)"""
+        for ref in releasing:
+            if not state.can_record_updated_refob(ref):
+                self.send_entry(state, is_busy=True)
+            ref.deactivate()
+            state.record_updated_refob(ref)
+
+    # ----------------------------------------------------------------- #
+    # Entry flushing
+    # ----------------------------------------------------------------- #
+
+    def send_entry(self, state: CrgcState, is_busy: bool) -> None:
+        """(reference: CRGC.scala:179-193)"""
+        try:
+            entry = self.entry_pool.popleft()
+            allocated = False
+        except IndexError:
+            entry = Entry(self.crgc_context)
+            allocated = True
+        state.flush_to_entry(is_busy, entry)
+        self.queue.append(entry)
+        if events.recorder.enabled:
+            events.recorder.commit(events.ENTRY_SEND, allocated_memory=allocated)
+
+    # ----------------------------------------------------------------- #
+
+    def shutdown(self) -> None:
+        self.bookkeeper.stop_timers()

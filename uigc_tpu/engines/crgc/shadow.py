@@ -1,0 +1,295 @@
+"""The oracle shadow graph: pointer-based, reference-exact semantics.
+
+This is the behavioral twin of the reference's collector-side graph
+(reference: crgc/Shadow.java:10-54, crgc/ShadowGraph.java:9-299).  The TPU
+data plane (``arrays.py`` / ``ops/trace.py``) must agree with this oracle
+on every liveness verdict; differential tests drive both over the same
+entry streams — the same technique the reference author used
+(ShadowGraph.java:176-199 ``assertEquals`` dual-graph debugging).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+from ...utils import events
+from .messages import StopMsg, WaveMsg
+from .state import CrgcContext, Entry
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ...runtime.cell import ActorCell
+    from .refob import CrgcRefob
+
+
+class Shadow:
+    """Collector-side image of one actor (reference: Shadow.java:10-54)."""
+
+    __slots__ = (
+        "self_cell",
+        "location",
+        "outgoing",
+        "supervisor",
+        "recv_count",
+        "mark",
+        "is_root",
+        "interned",
+        "is_local",
+        "is_busy",
+        "is_halted",
+    )
+
+    def __init__(self) -> None:
+        self.self_cell: Optional["ActorCell"] = None
+        self.location: Optional[str] = None
+        #: net created-minus-deactivated refs toward each target; may be
+        #: negative (reference: Shadow.java:14-19)
+        self.outgoing: Dict["Shadow", int] = {}
+        self.supervisor: Optional["Shadow"] = None
+        #: received minus sent; nonzero means undelivered messages exist
+        self.recv_count = 0
+        self.mark = False
+        self.is_root = False
+        self.interned = False
+        self.is_local = False
+        self.is_busy = False
+        self.is_halted = False
+
+    def __repr__(self) -> str:  # pragma: no cover
+        path = self.self_cell.path if self.self_cell is not None else "?"
+        return (
+            f"Shadow({path} recv={self.recv_count} root={self.is_root} "
+            f"busy={self.is_busy} interned={self.interned} local={self.is_local} "
+            f"halted={self.is_halted} out={len(self.outgoing)})"
+        )
+
+
+def _update_outgoing(outgoing: Dict[Shadow, int], target: Shadow, delta: int) -> None:
+    """Zero counts are deleted, not stored (reference: ShadowGraph.java:64-73)."""
+    count = outgoing.get(target, 0) + delta
+    if count == 0:
+        outgoing.pop(target, None)
+    else:
+        outgoing[target] = count
+
+
+class ShadowGraph:
+    """The detection structure (reference: ShadowGraph.java:9-299)."""
+
+    def __init__(self, context: CrgcContext, local_address: Optional[str] = None):
+        self.context = context
+        #: address of the node this collector serves; shadows created from
+        #: entries are local to it
+        self.local_address = local_address
+        self.marked = True  # polarity flips every trace (ShadowGraph.java:11)
+        self.total_actors_seen = 0
+        self.from_set: List[Shadow] = []
+        self.shadow_map: Dict["ActorCell", Shadow] = {}
+
+    # ------------------------------------------------------------- #
+    # Shadow lookup
+    # ------------------------------------------------------------- #
+
+    def get_shadow_for_refob(self, refob: "CrgcRefob") -> Shadow:
+        """Cache-aware lookup (reference: ShadowGraph.java:23-33)."""
+        shadow = refob.target_shadow
+        if shadow is not None and shadow is self.shadow_map.get(refob.target):
+            return shadow
+        shadow = self.get_shadow(refob.target)
+        refob.target_shadow = shadow
+        return shadow
+
+    def get_shadow(self, cell: "ActorCell") -> Shadow:
+        """(reference: ShadowGraph.java:35-43)"""
+        shadow = self.shadow_map.get(cell)
+        if shadow is not None:
+            return shadow
+        return self.make_shadow(cell)
+
+    def make_shadow(self, cell: "ActorCell") -> Shadow:
+        """(reference: ShadowGraph.java:45-62)"""
+        self.total_actors_seen += 1
+        shadow = Shadow()
+        shadow.self_cell = cell
+        shadow.location = cell.system.address
+        shadow.mark = not self.marked  # unmarked under current polarity
+        shadow.interned = False
+        shadow.is_local = False
+        self.shadow_map[cell] = shadow
+        self.from_set.append(shadow)
+        return shadow
+
+    # ------------------------------------------------------------- #
+    # Folding snapshots
+    # ------------------------------------------------------------- #
+
+    def merge_entry(self, entry: Entry) -> None:
+        """Fold one mutator snapshot (reference: ShadowGraph.java:75-125)."""
+        self_shadow = self.get_shadow_for_refob(entry.self_ref)
+        self_shadow.interned = True
+        self_shadow.is_local = True
+        self_shadow.recv_count += entry.recv_count
+        self_shadow.is_busy = entry.is_busy
+        self_shadow.is_root = entry.is_root
+
+        field_size = self.context.entry_field_size
+
+        # Created refs: owner gains an outgoing edge toward target.
+        for i in range(field_size):
+            owner = entry.created_owners[i]
+            if owner is None:
+                break
+            target_shadow = self.get_shadow_for_refob(entry.created_targets[i])
+            owner_shadow = self.get_shadow_for_refob(owner)
+            _update_outgoing(owner_shadow.outgoing, target_shadow, 1)
+
+        # Spawned actors: set the child's supervisor.
+        for i in range(field_size):
+            child = entry.spawned_actors[i]
+            if child is None:
+                break
+            child_shadow = self.get_shadow_for_refob(child)
+            child_shadow.supervisor = self_shadow
+
+        # Updated refobs: sends count against the target's recv balance;
+        # deactivations remove an outgoing edge.
+        from . import refob as refob_info
+
+        for i in range(field_size):
+            target = entry.updated_refs[i]
+            if target is None:
+                break
+            target_shadow = self.get_shadow_for_refob(target)
+            info = entry.updated_infos[i]
+            send_count = refob_info.count(info)
+            if send_count > 0:
+                target_shadow.recv_count -= send_count  # may go negative
+            if not refob_info.is_active(info):
+                _update_outgoing(self_shadow.outgoing, target_shadow, -1)
+
+    # ------------------------------------------------------------- #
+    # The trace (reference: ShadowGraph.java:201-289)
+    # ------------------------------------------------------------- #
+
+    @staticmethod
+    def is_pseudo_root(shadow: Shadow) -> bool:
+        """(reference: ShadowGraph.java:201-203)"""
+        return (
+            shadow.is_root
+            or shadow.is_busy
+            or shadow.recv_count != 0
+            or not shadow.interned
+        ) and not shadow.is_halted
+
+    def trace(self, should_kill: bool) -> int:
+        """Mark-and-sweep over the shadow graph; returns the number of
+        garbage actors found.  Unmarked local actors whose supervisor is
+        marked get a StopMsg — killing the oldest unmarked ancestor kills
+        the subtree via the runtime's stop cascade
+        (reference: ShadowGraph.java:205-289)."""
+        marked = self.marked
+        with events.recorder.timed(events.TRACING) as ev:
+            to_set: List[Shadow] = []
+            for shadow in self.from_set:
+                if self.is_pseudo_root(shadow):
+                    to_set.append(shadow)
+                    shadow.mark = marked
+
+            scanptr = 0
+            while scanptr < len(to_set):
+                owner = to_set[scanptr]
+                scanptr += 1
+                if owner.is_halted:
+                    # Nothing reachable from a halted actor stays alive on
+                    # its account (reference: ShadowGraph.java:226-229).
+                    continue
+                for target, count in owner.outgoing.items():
+                    if count > 0 and target.mark != marked:
+                        to_set.append(target)
+                        target.mark = marked
+                # Mark the supervisor so parents outlive descendants —
+                # deliberately incomplete (reference: ShadowGraph.java:242-267).
+                supervisor = owner.supervisor
+                if supervisor is not None and supervisor.mark != marked:
+                    to_set.append(supervisor)
+                    supervisor.mark = marked
+
+            num_garbage = 0
+            num_live = 0
+            for shadow in self.from_set:
+                if shadow.mark != marked:
+                    num_garbage += 1
+                    self.shadow_map.pop(shadow.self_cell, None)
+                    if (
+                        should_kill
+                        and shadow.is_local
+                        and not shadow.is_halted
+                        and shadow.supervisor is not None
+                        and shadow.supervisor.mark == marked
+                    ):
+                        shadow.self_cell.tell(StopMsg)
+                else:
+                    num_live += 1
+
+            self.from_set = to_set
+            self.marked = not marked
+            ev.fields["num_garbage_actors"] = num_garbage
+            ev.fields["num_live_actors"] = num_live
+        return num_garbage
+
+    def start_wave(self) -> int:
+        """Poke local roots to flush entries down the tree
+        (reference: ShadowGraph.java:291-299)."""
+        count = 0
+        for shadow in self.from_set:
+            if shadow.is_root and shadow.is_local:
+                count += 1
+                shadow.self_cell.tell(WaveMsg)
+        return count
+
+    # ------------------------------------------------------------- #
+    # Diagnostics (reference: ShadowGraph.java:176-199, 302-330)
+    # ------------------------------------------------------------- #
+
+    def assert_equals(self, other: "ShadowGraph") -> None:
+        """Differential-testing helper comparing two graphs built from the
+        same entry stream (reference: ShadowGraph.java:176-199)."""
+        assert set(self.shadow_map.keys()) == set(other.shadow_map.keys()), (
+            "shadow maps differ: "
+            f"only-here={[c.path for c in set(self.shadow_map) - set(other.shadow_map)]} "
+            f"only-there={[c.path for c in set(other.shadow_map) - set(self.shadow_map)]}"
+        )
+        for cell, mine in self.shadow_map.items():
+            theirs = other.shadow_map[cell]
+            assert mine.recv_count == theirs.recv_count, (mine, theirs)
+            assert mine.is_root == theirs.is_root, (mine, theirs)
+            assert mine.interned == theirs.interned, (mine, theirs)
+            assert mine.is_busy == theirs.is_busy, (mine, theirs)
+            mine_sup = mine.supervisor.self_cell if mine.supervisor else None
+            their_sup = theirs.supervisor.self_cell if theirs.supervisor else None
+            assert mine_sup is their_sup, (mine, theirs)
+            mine_out = {s.self_cell: c for s, c in mine.outgoing.items()}
+            their_out = {s.self_cell: c for s, c in theirs.outgoing.items()}
+            assert mine_out == their_out, (mine, theirs)
+
+    def count_reachable_from(self, address: str) -> int:
+        """How many actors are reachable from actors at ``address``
+        (reference: ShadowGraph.java:302-330)."""
+        to_set: List[Shadow] = []
+        marked = self.marked
+        for shadow in self.from_set:
+            if shadow.location == address:
+                to_set.append(shadow)
+                shadow.mark = marked
+        scanptr = 0
+        while scanptr < len(to_set):
+            owner = to_set[scanptr]
+            scanptr += 1
+            if owner.is_halted:
+                continue
+            for target, count in owner.outgoing.items():
+                if count > 0 and target.mark != marked:
+                    to_set.append(target)
+                    target.mark = marked
+        for shadow in to_set:
+            shadow.mark = not marked
+        return len(to_set)

@@ -1,0 +1,147 @@
+"""The pluggable GC-engine SPI.
+
+Mirrors the reference's ``Engine`` trait: 13 hook pairs through which every
+GC-relevant action in the user API funnels (reference:
+src/main/scala/edu/illinois/osl/uigc/engines/Engine.scala:19-223), plus the
+remoting interception hooks (Engine.scala:225-276).  Python's dynamic
+typing removes the need for the reference's ``*Impl``/cast bridging, so
+each hook appears once.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Any, Callable, Iterable, Optional
+
+from ..interfaces import GCMessage, Refob, SpawnInfo, State
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..runtime.cell import ActorCell
+    from ..runtime.context import ActorContext
+    from ..runtime.signals import Signal
+    from ..runtime.system import ActorSystem
+
+
+class TerminationDecision(enum.Enum):
+    """Verdicts returned by on_idle / post_signal
+    (reference: Engine.scala:11-16)."""
+
+    SHOULD_STOP = "should_stop"
+    SHOULD_CONTINUE = "should_continue"
+    UNHANDLED = "unhandled"
+
+
+class Engine:
+    """A GC engine: a collection of hooks and datatypes used by the
+    runtime.  One instance per ActorSystem (reference: Engine.scala:19)."""
+
+    def __init__(self, system: "ActorSystem"):
+        self.system = system
+
+    # -- Root-actor support ------------------------------------------- #
+
+    def root_message(self, payload: Any, refs: Iterable[Refob]) -> GCMessage:
+        """Wrap an external message for delivery to a root actor
+        (reference: Engine.scala:28-31)."""
+        raise NotImplementedError
+
+    def root_spawn_info(self) -> SpawnInfo:
+        """SpawnInfo marking an actor as a root (reference: Engine.scala:35-38)."""
+        raise NotImplementedError
+
+    def to_root_refob(self, cell: "ActorCell") -> Refob:
+        """Produce a refob for a root actor's cell (reference: Engine.scala:41-44)."""
+        raise NotImplementedError
+
+    # -- Lifecycle ----------------------------------------------------- #
+
+    def init_state(self, cell: "ActorCell", spawn_info: SpawnInfo) -> State:
+        """Compute the initial GC state of a managed actor
+        (reference: Engine.scala:48-60)."""
+        raise NotImplementedError
+
+    def get_self_ref(self, state: State, cell: "ActorCell") -> Refob:
+        """This actor's refob to itself (reference: Engine.scala:64-76)."""
+        raise NotImplementedError
+
+    def spawn(
+        self,
+        factory: Callable[[SpawnInfo], "ActorCell"],
+        state: State,
+        ctx: "ActorContext",
+    ) -> Refob:
+        """Spawn a managed actor (reference: Engine.scala:79-94)."""
+        raise NotImplementedError
+
+    # -- Message path -------------------------------------------------- #
+
+    def send_message(
+        self,
+        ref: Refob,
+        msg: Any,
+        refs: Iterable[Refob],
+        state: State,
+        ctx: "ActorContext",
+    ) -> None:
+        """Send an application message through a refob
+        (reference: Engine.scala:97-118)."""
+        raise NotImplementedError
+
+    def on_message(
+        self, msg: GCMessage, state: State, ctx: "ActorContext"
+    ) -> Optional[Any]:
+        """Intercept a delivered message; return the app payload, or None
+        for engine-internal control messages (reference: Engine.scala:120-135)."""
+        raise NotImplementedError
+
+    def on_idle(
+        self, msg: GCMessage, state: State, ctx: "ActorContext"
+    ) -> TerminationDecision:
+        """Called after the user handler for each message
+        (reference: Engine.scala:137-152)."""
+        raise NotImplementedError
+
+    # -- Signals ------------------------------------------------------- #
+
+    def pre_signal(self, signal: "Signal", state: State, ctx: "ActorContext") -> None:
+        """(reference: Engine.scala:154-169)"""
+
+    def post_signal(
+        self, signal: "Signal", state: State, ctx: "ActorContext"
+    ) -> TerminationDecision:
+        """(reference: Engine.scala:171-186)"""
+        return TerminationDecision.UNHANDLED
+
+    # -- Reference management ------------------------------------------ #
+
+    def create_ref(
+        self, target: Refob, owner: Refob, state: State, ctx: "ActorContext"
+    ) -> Refob:
+        """Create a reference to ``target`` destined for ``owner``
+        (reference: Engine.scala:188-206)."""
+        raise NotImplementedError
+
+    def release(
+        self, releasing: Iterable[Refob], state: State, ctx: "ActorContext"
+    ) -> None:
+        """Release references (reference: Engine.scala:208-223)."""
+        raise NotImplementedError
+
+    # -- Remoting interception ----------------------------------------- #
+    # The fabric instantiates these per link.  Default: pass-through, like
+    # the reference's default GraphStage logic (Engine.scala:225-276).
+
+    def spawn_egress(self, link: Any) -> Any:
+        """Return an egress interceptor for an outbound link, or None for
+        pass-through."""
+        return None
+
+    def spawn_ingress(self, link: Any) -> Any:
+        """Return an ingress interceptor for an inbound link, or None for
+        pass-through."""
+        return None
+
+    # -- Shutdown ------------------------------------------------------ #
+
+    def shutdown(self) -> None:
+        """Called on system termination (no reference analogue; ours)."""

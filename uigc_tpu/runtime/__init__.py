@@ -1,0 +1,19 @@
+from .behaviors import AbstractBehavior, ActorFactory, Behaviors, RawBehavior
+from .cell import ActorCell
+from .context import ActorContext
+from .signals import PostStop, Signal, Terminated
+from .system import ActorSystem, RawRef
+
+__all__ = [
+    "AbstractBehavior",
+    "ActorCell",
+    "ActorContext",
+    "ActorFactory",
+    "ActorSystem",
+    "Behaviors",
+    "PostStop",
+    "RawBehavior",
+    "RawRef",
+    "Signal",
+    "Terminated",
+]

@@ -1,0 +1,397 @@
+"""The actor cell: mailbox, scheduling discipline, lifecycle protocol.
+
+This is the runtime's equivalent of Akka's ActorCell plus the forked-Akka
+mailbox hook the reference depends on: the engine learns when an actor has
+drained its mailbox via ``on_finished_processing`` (reference:
+CRGC.scala:84-88 and MAC.scala:122-144 install
+``context.queue.onFinishedProcessingHook``).  In this runtime the hook is a
+first-class interface instead of a fork.
+
+Invariants:
+- A cell is processed by at most one dispatcher thread at a time
+  (the ``_scheduled`` flag is only cleared by the thread that owns the
+  batch, under ``_lock``).
+- System messages (stop protocol, child-termination notices) are processed
+  before application messages.
+- Stopping a cell stops its children first; PostStop runs after all
+  children have terminated, mirroring Akka's semantics that the reference's
+  supervisor-marking logic relies on (reference: ShadowGraph.java:242-267).
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from collections import deque
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
+
+from ..interfaces import GCMessage, Message
+from .signals import PostStop, Terminated
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .system import ActorSystem
+
+# Lifecycle states
+_ACTIVE = 0
+_STOPPING = 1
+_TERMINATED = 2
+
+
+class _SysStop:
+    __slots__ = ()
+
+
+class _SysChildTerminated:
+    __slots__ = ("child",)
+
+    def __init__(self, child: "ActorCell"):
+        self.child = child
+
+
+class _SysWatchedTerminated:
+    __slots__ = ("ref",)
+
+    def __init__(self, ref: "ActorCell"):
+        self.ref = ref
+
+
+_SYS_STOP = _SysStop()
+
+
+class ActorCell:
+    """A single actor: identity, mailbox, behavior, children, watchers."""
+
+    __slots__ = (
+        "system",
+        "uid",
+        "name",
+        "path",
+        "parent",
+        "children",
+        "is_root",
+        "is_managed",
+        "behavior",
+        "context",
+        "_mailbox",
+        "_sysbox",
+        "_lock",
+        "_scheduled",
+        "_lifecycle",
+        "_watchers",
+        "_watching",
+        "_dispatcher",
+        "_needs_block_hook",
+        "on_finished_processing",
+        "_anon_counter",
+    )
+
+    def __init__(
+        self,
+        system: "ActorSystem",
+        name: str,
+        parent: Optional["ActorCell"],
+        is_root: bool = False,
+        is_managed: bool = True,
+        dispatcher: Optional[Any] = None,
+    ):
+        self.system = system
+        self.uid = system.allocate_uid()
+        self.name = name
+        self.path = (parent.path + "/" + name) if parent is not None else "/" + name
+        self.parent = parent
+        self.children: Dict[str, ActorCell] = {}
+        self.is_root = is_root
+        self.is_managed = is_managed
+        self.behavior: Any = None
+        self.context: Any = None
+        self._mailbox: deque = deque()
+        self._sysbox: deque = deque()
+        self._lock = threading.Lock()
+        # Pre-claimed: no batch may run until start() releases the cell,
+        # so messages sent from the behavior's own constructor can't be
+        # processed before the behavior exists.
+        self._scheduled = True
+        self._lifecycle = _ACTIVE
+        self._watchers: List[ActorCell] = []
+        self._watching: set = set()
+        self._dispatcher = dispatcher or system.dispatcher
+        # Fire the finished-processing hook once after start, so on-block
+        # engines get an initial entry even from never-messaged actors.
+        self._needs_block_hook = True
+        self.on_finished_processing: Optional[Callable[[], None]] = None
+        self._anon_counter = 0
+
+    # ------------------------------------------------------------------ #
+    # Message delivery
+    # ------------------------------------------------------------------ #
+
+    def tell(self, msg: Any) -> None:
+        """Enqueue an application-level message (a GCMessage envelope from a
+        managed sender, or a raw payload destined for a root actor)."""
+        with self._lock:
+            if self._lifecycle != _ACTIVE:
+                dead = True
+            else:
+                dead = False
+                self._mailbox.append(msg)
+                dispatch = self._mark_scheduled()
+        if dead:
+            self.system.record_dead_letter(self, msg)
+            return
+        if dispatch:
+            self._dispatcher.execute(self._process_batch)
+
+    def tell_system(self, msg: Any) -> None:
+        with self._lock:
+            if self._lifecycle == _TERMINATED:
+                return
+            self._sysbox.append(msg)
+            dispatch = self._mark_scheduled()
+        if dispatch:
+            self._dispatcher.execute(self._process_batch)
+
+    def _mark_scheduled(self) -> bool:
+        """Caller must hold ``_lock``. Returns True if the caller must
+        dispatch the cell."""
+        if self._scheduled:
+            return False
+        self._scheduled = True
+        return True
+
+    # ------------------------------------------------------------------ #
+    # Scheduling / processing
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        """Run the initial (possibly empty) batch after spawn.
+
+        The cell is constructed with ``_scheduled`` pre-claimed; this hands
+        it to the dispatcher for the first time.  The initial batch also
+        fires the finished-processing hook, so on-block engines flush an
+        initial entry even for never-messaged actors.
+        """
+        self._dispatcher.execute(self._process_batch)
+
+    def _process_batch(self) -> None:
+        throughput = self.system.throughput
+        processed = 0
+        while True:
+            # System messages always drain first.
+            while True:
+                with self._lock:
+                    sysmsg = self._sysbox.popleft() if self._sysbox else None
+                if sysmsg is None:
+                    break
+                self._invoke_system(sysmsg)
+            if self._lifecycle != _ACTIVE or processed >= throughput:
+                break
+            with self._lock:
+                msg = self._mailbox.popleft() if self._mailbox else None
+            if msg is None:
+                break
+            processed += 1
+            self._needs_block_hook = True
+            self._invoke(msg)
+
+        # Mailbox drained while active: fire the finished-processing hook
+        # (the forked-Akka ``onFinishedProcessingHook`` analogue) before we
+        # give up ownership of the cell, so engine state is never touched
+        # by two threads at once.
+        if (
+            self._lifecycle == _ACTIVE
+            and self._needs_block_hook
+            and self.on_finished_processing is not None
+        ):
+            with self._lock:
+                empty = not self._mailbox and not self._sysbox
+            if empty:
+                self._needs_block_hook = False
+                try:
+                    self.on_finished_processing()
+                except Exception:  # pragma: no cover - defensive
+                    traceback.print_exc()
+
+        with self._lock:
+            if self._lifecycle != _TERMINATED and (self._mailbox or self._sysbox):
+                redispatch = True
+            else:
+                self._scheduled = False
+                redispatch = False
+        if redispatch:
+            self._dispatcher.execute(self._process_batch)
+
+    # ------------------------------------------------------------------ #
+    # Invocation (the engine sandwich)
+    # ------------------------------------------------------------------ #
+
+    def _invoke(self, msg: Any) -> None:
+        """Deliver one message through the engine sandwich (reference:
+        AbstractBehavior.scala:16-31)."""
+        from ..engines.engine import TerminationDecision
+        from .behaviors import StoppedBehavior
+
+        behavior = self.behavior
+        if not self.is_managed:
+            try:
+                result = behavior.on_message(msg)
+            except Exception:
+                traceback.print_exc()
+                self._initiate_stop()
+                return
+            self._apply_behavior_result(result)
+            return
+
+        engine = self.system.engine
+        ctx = self.context
+        if not isinstance(msg, GCMessage):
+            # External message to a root actor: wrap it so the engine can
+            # track its refs (reference: Behaviors.scala:20-29 RootAdapter).
+            refs = msg.refs if isinstance(msg, Message) else ()
+            msg = engine.root_message(msg, refs)
+
+        payload = engine.on_message(msg, ctx.state, ctx)
+        result = None
+        if payload is not None:
+            try:
+                result = behavior.on_message(payload)
+            except Exception:
+                traceback.print_exc()
+                # Akka typed's default supervision stops a failing actor.
+                self._initiate_stop()
+                return
+
+        decision = engine.on_idle(msg, ctx.state, ctx)
+        if decision is TerminationDecision.SHOULD_STOP or isinstance(
+            result, StoppedBehavior
+        ):
+            self._initiate_stop()
+        else:
+            self._apply_behavior_result(result)
+
+    def _invoke_signal(self, signal: Any) -> None:
+        """Deliver a lifecycle signal through the engine sandwich
+        (reference: AbstractBehavior.scala:33-54)."""
+        from ..engines.engine import TerminationDecision
+        from .behaviors import StoppedBehavior
+
+        behavior = self.behavior
+        if behavior is None:
+            return
+        if not self.is_managed:
+            try:
+                behavior.on_signal(signal)
+            except Exception:  # pragma: no cover - defensive
+                traceback.print_exc()
+            return
+
+        engine = self.system.engine
+        ctx = self.context
+        engine.pre_signal(signal, ctx.state, ctx)
+        result = None
+        try:
+            result = behavior.on_signal(signal)
+        except Exception:
+            traceback.print_exc()
+
+        decision = engine.post_signal(signal, ctx.state, ctx)
+        if decision is TerminationDecision.SHOULD_STOP or isinstance(
+            result, StoppedBehavior
+        ):
+            self._initiate_stop()
+        else:
+            self._apply_behavior_result(result)
+
+    def _apply_behavior_result(self, result: Any) -> None:
+        from .behaviors import SameBehavior
+
+        if result is None or isinstance(result, SameBehavior) or result is self.behavior:
+            return
+        from .behaviors import StoppedBehavior
+
+        if isinstance(result, StoppedBehavior):
+            self._initiate_stop()
+        else:
+            self.behavior = result
+
+    # ------------------------------------------------------------------ #
+    # System-message handling (stop protocol, watch)
+    # ------------------------------------------------------------------ #
+
+    def _invoke_system(self, msg: Any) -> None:
+        if isinstance(msg, _SysStop):
+            self._initiate_stop()
+        elif isinstance(msg, _SysChildTerminated):
+            self.children.pop(msg.child.name, None)
+            if self._lifecycle == _STOPPING and not self.children:
+                self._finalize()
+        elif isinstance(msg, _SysWatchedTerminated):
+            self._watching.discard(msg.ref)
+            if self._lifecycle != _TERMINATED:
+                self._invoke_signal(Terminated(msg.ref))
+
+    def _initiate_stop(self) -> None:
+        """Begin termination: stop children first, then finalize."""
+        if self._lifecycle != _ACTIVE:
+            return
+        self._lifecycle = _STOPPING
+        if self.children:
+            for child in list(self.children.values()):
+                child.tell_system(_SYS_STOP)
+        else:
+            self._finalize()
+
+    def _finalize(self) -> None:
+        """All children are gone: run PostStop, notify watchers and parent."""
+        if self._lifecycle == _TERMINATED:
+            return
+        self._invoke_signal(PostStop)
+        with self._lock:
+            self._lifecycle = _TERMINATED
+            dropped = len(self._mailbox)
+            self._mailbox.clear()
+            watchers = list(self._watchers)
+            self._watchers.clear()
+        if dropped:
+            self.system.record_dead_letters_dropped(self, dropped)
+        for watcher in watchers:
+            watcher.tell_system(_SysWatchedTerminated(self))
+        if self.parent is not None:
+            self.parent.tell_system(_SysChildTerminated(self))
+        self.system.unregister_cell(self)
+
+    def stop(self) -> None:
+        """Request this actor to stop (external, e.g. system shutdown)."""
+        self.tell_system(_SYS_STOP)
+
+    # ------------------------------------------------------------------ #
+    # Watch / misc
+    # ------------------------------------------------------------------ #
+
+    def watch(self, other: "ActorCell") -> None:
+        """Subscribe to ``other``'s termination (Akka's ``context.watch``;
+        the reference's MAC engine watches children, MAC.scala:161)."""
+        notify_now = False
+        with other._lock:
+            if other._lifecycle == _TERMINATED:
+                notify_now = True
+            else:
+                other._watchers.append(self)
+        if notify_now:
+            self.tell_system(_SysWatchedTerminated(other))
+        else:
+            self._watching.add(other)
+
+    def next_anonymous_name(self) -> str:
+        self._anon_counter += 1
+        return f"${self._anon_counter}"
+
+    @property
+    def is_terminated(self) -> bool:
+        return self._lifecycle == _TERMINATED
+
+    @property
+    def is_active(self) -> bool:
+        return self._lifecycle == _ACTIVE
+
+    def __repr__(self) -> str:
+        return f"ActorCell({self.path}#{self.uid})"

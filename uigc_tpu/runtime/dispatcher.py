@@ -1,0 +1,179 @@
+"""Thread-pool dispatcher, pinned dispatcher and timer service.
+
+The reference runs mutator actors on Akka's default dispatcher and the GC
+collector on a dedicated pinned thread (reference: reference.conf:11-14,
+CRGC.scala:54-58).  This module provides both: a shared worker pool that
+runs actor message batches, and per-actor pinned threads for system actors
+like the Bookkeeper.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+
+class Dispatcher:
+    """Fixed worker pool executing actor batches from a shared run queue."""
+
+    _SHUTDOWN = object()
+
+    def __init__(self, num_workers: int, name: str = "uigc-dispatcher"):
+        self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        self._workers = []
+        self._shutdown = False
+        for i in range(num_workers):
+            t = threading.Thread(
+                target=self._run, name=f"{name}-{i}", daemon=True
+            )
+            t.start()
+            self._workers.append(t)
+
+    def execute(self, runnable: Callable[[], None]) -> None:
+        if not self._shutdown:
+            self._queue.put(runnable)
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is Dispatcher._SHUTDOWN:
+                return
+            try:
+                item()
+            except Exception:  # pragma: no cover - defensive
+                traceback.print_exc()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        for _ in self._workers:
+            self._queue.put(Dispatcher._SHUTDOWN)
+        for t in self._workers:
+            t.join(timeout=5)
+
+
+class PinnedDispatcher:
+    """A dedicated thread for one actor — the ``my-pinned-dispatcher``
+    analogue (reference: reference.conf:11-14)."""
+
+    _SHUTDOWN = object()
+
+    def __init__(self, name: str):
+        self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def execute(self, runnable: Callable[[], None]) -> None:
+        if not self._shutdown:
+            self._queue.put(runnable)
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is PinnedDispatcher._SHUTDOWN:
+                return
+            try:
+                item()
+            except Exception:  # pragma: no cover - defensive
+                traceback.print_exc()
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._queue.put(PinnedDispatcher._SHUTDOWN)
+        self._thread.join(timeout=5)
+
+
+class TimerService:
+    """Monotonic-clock timer wheel driving collector wakeups and user timers.
+
+    Stands in for Akka's scheduler (reference: LocalGC.scala:211-224 uses
+    ``timers.startTimerWithFixedDelay``).
+    """
+
+    def __init__(self, name: str = "uigc-timers"):
+        self._heap: list = []
+        self._cond = threading.Condition()
+        self._cancelled: Dict[Any, bool] = {}
+        self._counter = itertools.count()
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def schedule_once(self, delay_s: float, fn: Callable[[], None], key: Any = None) -> Any:
+        return self._schedule(delay_s, fn, key, repeat_s=None)
+
+    def schedule_fixed_delay(self, interval_s: float, fn: Callable[[], None], key: Any = None) -> Any:
+        """Run ``fn`` every ``interval_s`` seconds, measured from completion
+        (fixed delay, like ``startTimerWithFixedDelay``)."""
+        return self._schedule(interval_s, fn, key, repeat_s=interval_s)
+
+    def _schedule(self, delay_s: float, fn: Callable, key: Any, repeat_s: Optional[float]) -> Any:
+        import time
+
+        if key is None:
+            key = object()
+        with self._cond:
+            self._cancelled[key] = False
+            heapq.heappush(
+                self._heap,
+                (time.monotonic() + delay_s, next(self._counter), key, fn, repeat_s),
+            )
+            self._cond.notify()
+        return key
+
+    def cancel(self, key: Any) -> None:
+        with self._cond:
+            if key in self._cancelled:
+                self._cancelled[key] = True
+
+    def cancel_all(self) -> None:
+        with self._cond:
+            for key in self._cancelled:
+                self._cancelled[key] = True
+
+    def _run(self) -> None:
+        import time
+
+        while True:
+            with self._cond:
+                if self._shutdown:
+                    return
+                now = time.monotonic()
+                if not self._heap:
+                    self._cond.wait(timeout=0.5)
+                    continue
+                when, _, key, fn, repeat_s = self._heap[0]
+                if when > now:
+                    self._cond.wait(timeout=min(when - now, 0.5))
+                    continue
+                heapq.heappop(self._heap)
+                cancelled = self._cancelled.get(key, True)
+                if cancelled and repeat_s is None:
+                    self._cancelled.pop(key, None)
+            if cancelled:
+                if repeat_s is not None:
+                    with self._cond:
+                        self._cancelled.pop(key, None)
+                continue
+            try:
+                fn()
+            except Exception:  # pragma: no cover - defensive
+                traceback.print_exc()
+            if repeat_s is not None:
+                with self._cond:
+                    if not self._shutdown and not self._cancelled.get(key, True):
+                        heapq.heappush(
+                            self._heap,
+                            (time.monotonic() + repeat_s, next(self._counter), key, fn, repeat_s),
+                        )
+                        self._cond.notify()
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify()
+        self._thread.join(timeout=5)

@@ -1,0 +1,72 @@
+"""Remote spawning: the RemoteSpawner service actor.
+
+Mirrors the reference's keyed-factory spawn service (reference:
+package.scala:28-47): a node hosts a ``RemoteSpawner`` registered with
+named behavior factories; peers ask it to spawn, passing SpawnInfo, and
+block on the reply (reference: ActorContext.scala:48-65).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Any, Dict
+
+from .behaviors import ActorFactory, RawBehavior
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .cell import ActorCell
+    from .system import ActorSystem
+
+
+class _Spawn:
+    __slots__ = ("factory_key", "spawn_info", "reply")
+
+    def __init__(self, factory_key: str, spawn_info: Any, reply: "threading.Event"):
+        self.factory_key = factory_key
+        self.spawn_info = spawn_info
+        self.reply = reply
+        # The reply event doubles as the result carrier.
+        self.reply.result = None  # type: ignore[attr-defined]
+
+
+class RemoteSpawner(RawBehavior):
+    """Unmanaged service actor holding a keyed registry of actor factories
+    (reference: package.scala:33-46)."""
+
+    def __init__(self, system: "ActorSystem", factories: Dict[str, ActorFactory]):
+        self._system = system
+        self._factories = factories
+        self._cell: Any = None
+        self._anon = 0
+
+    def bind(self, cell: "ActorCell") -> None:
+        self._cell = cell
+
+    def on_message(self, msg: Any) -> Any:
+        if isinstance(msg, _Spawn):
+            factory = self._factories[msg.factory_key]
+            self._anon += 1
+            child = self._system.spawn_cell(
+                factory, f"remote-{self._anon}", self._cell, msg.spawn_info
+            )
+            msg.reply.result = child  # type: ignore[attr-defined]
+            msg.reply.set()
+        return None
+
+    @staticmethod
+    def spawn_service(
+        system: "ActorSystem", factories: Dict[str, ActorFactory], name: str = "RemoteSpawner"
+    ) -> "ActorCell":
+        behavior = RemoteSpawner(system, factories)
+        return system.spawn_system_raw(behavior, name)
+
+
+def remote_spawn(location: Any, factory_key: str, spawn_info: Any, timeout_s: float = 60.0):
+    """Blocking ask to a RemoteSpawner cell; returns the spawned cell
+    (reference: ActorContext.scala:48-65)."""
+    cell = location.cell if hasattr(location, "cell") else location
+    event = threading.Event()
+    cell.tell(_Spawn(factory_key, spawn_info, event))
+    if not event.wait(timeout_s):
+        raise TimeoutError(f"remote spawn of {factory_key!r} timed out")
+    return event.result  # type: ignore[attr-defined]

@@ -1,0 +1,228 @@
+"""The actor system: spawning, guardians, engine extension, shutdown.
+
+Mirrors the reference's ``uigc.ActorSystem`` + ``UIGC`` extension factory
+(reference: ActorSystem.scala:13-27, UIGC.scala:12-19): the engine is a
+per-system singleton chosen by ``uigc.engine`` config, and the guardian is
+bootstrapped with root spawn info.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Mapping, Optional
+
+from ..config import Config
+from .behaviors import ActorFactory, RawBehavior
+from .cell import ActorCell
+from .context import ActorContext
+from .dispatcher import Dispatcher, PinnedDispatcher, TimerService
+
+
+class RawRef:
+    """External (unmanaged) handle to an actor — what ``testKit.spawn``
+    returns in the reference tests.  Sends raw payloads; at a root actor
+    they get wrapped by the engine's root adapter."""
+
+    __slots__ = ("cell",)
+
+    def __init__(self, cell: ActorCell):
+        self.cell = cell
+
+    def tell(self, msg: Any) -> None:
+        self.cell.tell(msg)
+
+    @property
+    def path(self) -> str:
+        return self.cell.path
+
+    @property
+    def is_terminated(self) -> bool:
+        return self.cell.is_terminated
+
+    def __repr__(self) -> str:
+        return f"RawRef({self.cell.path})"
+
+
+class _GuardianBehavior(RawBehavior):
+    def on_message(self, msg: Any) -> Any:
+        return None
+
+
+class ActorSystem:
+    """A single node's actor system."""
+
+    def __init__(
+        self,
+        guardian: Optional[ActorFactory] = None,
+        name: str = "uigc",
+        config: Optional[Mapping[str, Any]] = None,
+        address: Optional[str] = None,
+        fabric: Optional[Any] = None,
+    ):
+        self.name = name
+        self.config = config if isinstance(config, Config) else Config(config)
+        self.address = address or f"uigc://{name}"
+        #: Multi-node fabric this system is attached to (None = single node).
+        self.fabric = fabric
+        self._uid_counter = itertools.count(1)
+        self._uid_lock = threading.Lock()
+        self.throughput = self.config.get_int("uigc.runtime.throughput")
+        self.dispatcher = Dispatcher(
+            self.config.get_int("uigc.runtime.num-workers"), name=f"{name}-dispatcher"
+        )
+        self.timers = TimerService(name=f"{name}-timers")
+        self._pinned: list = []
+        self._cells: Dict[int, ActorCell] = {}
+        self._cells_lock = threading.Lock()
+        self.dead_letters = 0
+        self._terminated = threading.Event()
+
+        # Top-level guardians (raw).
+        self._system_guardian = self._make_raw_cell("system", None)
+        self._user_guardian = self._make_raw_cell("user", None)
+
+        # Engine extension: one per system, chosen by config
+        # (reference: UIGC.scala:12-19).
+        from ..engines import create_engine
+
+        self.engine = create_engine(self)
+
+        if fabric is not None:
+            fabric.register_system(self)
+
+        # User guardian actor, bootstrapped with root spawn info
+        # (reference: ActorSystem.scala:24-27).
+        self.guardian_ref: Optional[RawRef] = None
+        if guardian is not None:
+            cell = self.spawn_cell(
+                guardian, "guardian", self._user_guardian, self.engine.root_spawn_info()
+            )
+            self.guardian_ref = RawRef(cell)
+
+    # --------------------------------------------------------------- #
+    # Spawning
+    # --------------------------------------------------------------- #
+
+    def spawn_cell(
+        self,
+        factory: ActorFactory,
+        name: str,
+        parent: ActorCell,
+        spawn_info: Any,
+    ) -> ActorCell:
+        """Create and start a managed actor cell. The behavior's
+        constructor runs synchronously; no message is processed before it
+        returns."""
+        cell = ActorCell(
+            self, name, parent, is_root=factory.is_root, is_managed=True
+        )
+        if name in parent.children:
+            raise ValueError(f"duplicate actor name {name!r} under {parent.path}")
+        parent.children[name] = cell
+        ctx = ActorContext(cell, spawn_info)
+        cell.context = ctx
+        cell.behavior = factory.setup_fn(ctx)
+        self.register_cell(cell)
+        cell.start()
+        return cell
+
+    def spawn_root(self, factory: ActorFactory, name: str) -> RawRef:
+        """Spawn a top-level root actor (what ``testKit.spawn`` does in the
+        reference tests).  ``factory`` must come from
+        ``Behaviors.setup_root`` (or ``with_timers`` around it)."""
+        if not factory.is_root:
+            raise ValueError("spawn_root requires a Behaviors.setup_root factory")
+        cell = self.spawn_cell(
+            factory, name, self._user_guardian, self.engine.root_spawn_info()
+        )
+        return RawRef(cell)
+
+    def spawn_system_raw(
+        self, behavior: RawBehavior, name: str, pinned: bool = False
+    ) -> ActorCell:
+        """Spawn an unmanaged system actor (the Bookkeeper/CycleDetector
+        path; reference: CRGC.scala:54-58 uses a pinned dispatcher)."""
+        dispatcher = None
+        if pinned:
+            dispatcher = PinnedDispatcher(f"{self.name}-{name}-pinned")
+            self._pinned.append(dispatcher)
+        cell = ActorCell(
+            self,
+            name,
+            self._system_guardian,
+            is_root=False,
+            is_managed=False,
+            dispatcher=dispatcher,
+        )
+        self._system_guardian.children[name] = cell
+        cell.behavior = behavior
+        if hasattr(behavior, "bind"):
+            behavior.bind(cell)
+        self.register_cell(cell)
+        cell.start()
+        return cell
+
+    def _make_raw_cell(self, name: str, parent: Optional[ActorCell]) -> ActorCell:
+        cell = ActorCell(self, name, parent, is_managed=False)
+        cell.behavior = _GuardianBehavior()
+        self.register_cell(cell)
+        cell.start()
+        return cell
+
+    # --------------------------------------------------------------- #
+    # Registry / bookkeeping
+    # --------------------------------------------------------------- #
+
+    def allocate_uid(self) -> int:
+        with self._uid_lock:
+            return next(self._uid_counter)
+
+    def register_cell(self, cell: ActorCell) -> None:
+        with self._cells_lock:
+            self._cells[cell.uid] = cell
+
+    def unregister_cell(self, cell: ActorCell) -> None:
+        with self._cells_lock:
+            self._cells.pop(cell.uid, None)
+
+    def record_dead_letter(self, cell: ActorCell, msg: Any) -> None:
+        self.dead_letters += 1
+
+    def record_dead_letters_dropped(self, cell: ActorCell, count: int) -> None:
+        self.dead_letters += count
+
+    @property
+    def live_actor_count(self) -> int:
+        with self._cells_lock:
+            return len(self._cells)
+
+    # --------------------------------------------------------------- #
+    # Shutdown
+    # --------------------------------------------------------------- #
+
+    def terminate(self, timeout_s: float = 10.0) -> None:
+        """Stop the user guardian tree, then system actors, then the
+        machinery."""
+        import time
+
+        self._user_guardian.stop()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and not self._user_guardian.is_terminated:
+            time.sleep(0.005)
+        if hasattr(self.engine, "shutdown"):
+            self.engine.shutdown()
+        self._system_guardian.stop()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not self._system_guardian.is_terminated:
+            time.sleep(0.005)
+        self.timers.shutdown()
+        for pinned in self._pinned:
+            pinned.shutdown()
+        self.dispatcher.shutdown()
+        if self.fabric is not None:
+            self.fabric.unregister_system(self)
+        self._terminated.set()
+
+    def when_terminated(self, timeout_s: Optional[float] = None) -> bool:
+        return self._terminated.wait(timeout_s)

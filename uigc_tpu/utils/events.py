@@ -1,0 +1,115 @@
+"""Structured observability events — the JFR analogue.
+
+The reference instruments every pipeline stage with Java Flight Recorder
+events under category "UIGC" (reference: src/main/java/.../crgc/jfr/*,
+.../mac/jfr/*, PROFILING.md:1-10).  This module provides the same event
+vocabulary as cheap in-process counters plus optional listeners, so a
+profiler (or a test) can observe the pipeline without touching engine code.
+
+Events are disabled by default, like the reference's ``@Enabled(false)``
+flush events; enable with :func:`enable` or per-category.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+# Event names, mirroring the reference's JFR classes:
+#   crgc/jfr/EntrySendEvent, EntryFlushEvent, ProcessingEntries,
+#   TracingEvent, MergingDeltaGraphs, MergingIngressEntries,
+#   DeltaGraphSerialization, IngressEntrySerialization
+#   mac/jfr/ActorBlockedEvent, ProcessingMessages
+ENTRY_SEND = "crgc.entry_send"
+ENTRY_FLUSH = "crgc.entry_flush"
+PROCESSING_ENTRIES = "crgc.processing_entries"
+TRACING = "crgc.tracing"
+MERGING_DELTA_GRAPHS = "crgc.merging_delta_graphs"
+MERGING_INGRESS_ENTRIES = "crgc.merging_ingress_entries"
+DELTA_GRAPH_SERIALIZATION = "crgc.delta_graph_serialization"
+INGRESS_ENTRY_SERIALIZATION = "crgc.ingress_entry_serialization"
+ACTOR_BLOCKED = "mac.actor_blocked"
+PROCESSING_MESSAGES = "mac.processing_messages"
+DEVICE_TRACE = "tpu.device_trace"  # ours: one device kernel dispatch
+
+
+class EventRecorder:
+    """Thread-safe counter/duration sink with optional listeners."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = False
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._sums: Dict[str, float] = defaultdict(float)
+        self._durations: Dict[str, List[float]] = defaultdict(list)
+        self._listeners: List[Callable[[str, Dict[str, Any]], None]] = []
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def add_listener(self, fn: Callable[[str, Dict[str, Any]], None]) -> None:
+        with self._lock:
+            self._listeners.append(fn)
+
+    def commit(self, name: str, duration_s: Optional[float] = None, **fields: Any) -> None:
+        """Record one event occurrence (the JFR ``commit()`` analogue)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counts[name] += 1
+            for key, value in fields.items():
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    self._sums[f"{name}.{key}"] += value
+            if duration_s is not None:
+                self._durations[name].append(duration_s)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(name, dict(fields, duration_s=duration_s))
+
+    def timed(self, name: str) -> "_Timed":
+        return _Timed(self, name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {"counts": dict(self._counts), "sums": dict(self._sums)}
+            out["durations"] = {
+                k: {"n": len(v), "total_s": sum(v), "max_s": max(v) if v else 0.0}
+                for k, v in self._durations.items()
+            }
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._durations.clear()
+
+
+class _Timed:
+    """Context manager for timed events (the begin()/commit() pair)."""
+
+    __slots__ = ("_recorder", "_name", "_start", "fields")
+
+    def __init__(self, recorder: EventRecorder, name: str):
+        self._recorder = recorder
+        self._name = name
+        self._start = 0.0
+        self.fields: Dict[str, Any] = {}
+
+    def __enter__(self) -> "_Timed":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._recorder.commit(
+            self._name, duration_s=time.perf_counter() - self._start, **self.fields
+        )
+
+
+#: Process-wide recorder, like the JVM-global JFR stream.
+recorder = EventRecorder()
