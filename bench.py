@@ -1,0 +1,125 @@
+"""Benchmark: garbage detection throughput on a power-law actor graph.
+
+BASELINE config 5: a synthetic power-law refob graph, batched device trace.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star target (BASELINE.json) is >=10M garbage actors/sec with
+<=10ms p50 detection latency at a 10M-actor graph; vs_baseline is
+throughput relative to that 10M/s target (no published reference numbers
+exist — BASELINE.md documents the absence).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=None, help="number of actors")
+    parser.add_argument("--reps", type=int, default=20)
+    parser.add_argument("--garbage-fraction", type=float, default=0.5)
+    parser.add_argument("--small", action="store_true", help="quick CPU-sized run")
+    args = parser.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if args.n is None:
+        if args.small:
+            n = 1 << 16
+        elif platform == "tpu":
+            n = 10_000_000
+        else:
+            n = 1 << 20
+    else:
+        n = args.n
+
+    from uigc_tpu.models import powerlaw_actor_graph
+    from uigc_tpu.ops import trace as trace_ops
+
+    graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=args.garbage_fraction)
+
+    if "fn" not in trace_ops._jax_trace_cache:
+        trace_ops._jax_trace_cache["fn"] = trace_ops._build_jax_trace()
+    fn = trace_ops._jax_trace_cache["fn"]
+
+    dev_args = [
+        jax.device_put(x)
+        for x in (
+            graph["flags"],
+            graph["recv_count"],
+            graph["supervisor"],
+            graph["edge_src"].astype(np.int32),
+            graph["edge_dst"].astype(np.int32),
+            graph["edge_weight"],
+        )
+    ]
+
+    # Warmup / compile, and verify verdicts.
+    mark = fn(*dev_args)
+    in_use = (graph["flags"] & trace_ops.FLAG_IN_USE) != 0
+    garbage = in_use & ~np.asarray(mark)
+    n_garbage = int(garbage.sum())
+    assert np.array_equal(garbage, graph["expected_garbage"]), "wrong verdicts"
+
+    # Sustained collector throughput: chain `reps` traces inside one jit
+    # with an optimization barrier between them (the driver tunnel adds a
+    # ~70ms sync floor per host round-trip, and async dispatch makes
+    # naive per-call timing meaningless — block_until_ready does not
+    # actually block on this transport; only value readback syncs).
+    import jax.numpy as jnp
+
+    reps = args.reps
+
+    @jax.jit
+    def chained(flags, recv, sup, esrc, edst, ew):
+        def body(_, carry):
+            acc, state = carry
+            flags, recv, sup, esrc, edst, ew = state
+            mark = fn(flags, recv, sup, esrc, edst, ew)
+            # Real data dependency so no trace can be elided or fused
+            # away across iterations.
+            acc = acc + jnp.count_nonzero(mark)
+            state = jax.lax.optimization_barrier(state)
+            return acc, state
+        acc, _ = jax.lax.fori_loop(
+            0, reps, body, (0, (flags, recv, sup, esrc, edst, ew))
+        )
+        return acc
+
+    int(chained(*dev_args))  # compile
+    t0 = time.perf_counter()
+    int(chained(*dev_args))  # forces full completion via readback
+    total = time.perf_counter() - t0
+
+    # One-shot wall latency (includes transport sync floor).
+    t0 = time.perf_counter()
+    one = fn(*dev_args)
+    int(one.sum())
+    one_shot = time.perf_counter() - t0
+
+    p50 = total / reps
+    throughput = n_garbage / p50
+    target = 10_000_000.0  # north-star garbage actors/sec (BASELINE.json)
+
+    result = {
+        "metric": "garbage_actors_per_sec",
+        "value": round(throughput, 1),
+        "unit": "actors/s",
+        "vs_baseline": round(throughput / target, 4),
+        "p50_detection_ms": round(p50 * 1e3, 3),
+        "one_shot_ms": round(one_shot * 1e3, 3),
+        "n_actors": n,
+        "n_garbage": n_garbage,
+        "n_edges": int(graph["edge_src"].shape[0]),
+        "platform": platform,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

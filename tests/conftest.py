@@ -1,11 +1,20 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so
-multi-chip sharding is exercised without TPU hardware."""
+multi-chip sharding is exercised without TPU hardware.
+
+Note: on this machine an 'axon' TPU plugin wins platform selection even
+when JAX_PLATFORMS=cpu is set in the environment; only
+``jax.config.update("jax_platforms", "cpu")`` reliably overrides it, and
+XLA_FLAGS must be set before backend initialization.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

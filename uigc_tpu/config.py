@@ -38,7 +38,7 @@ DEFAULTS: Dict[str, Any] = {
     #   "oracle" - pointer-based graph mirroring the JVM semantics exactly
     #   "array"  - dense-array graph folded on host (numpy)
     #   "device" - dense-array graph with the trace run on the TPU via JAX
-    "uigc.crgc.shadow-graph": "oracle",
+    "uigc.crgc.shadow-graph": "array",
     # --- MAC engine settings (reference: reference.conf:43-50) ---
     "uigc.mac.cycle-detection": False,
     # Milliseconds between cycle-detector wakeups (reference:

@@ -89,7 +89,7 @@ class CRGC(Engine):
     # ----------------------------------------------------------------- #
 
     def root_message(self, payload: Any, refs: Iterable[Refob]) -> GCMessage:
-        return AppMsg(payload, refs)
+        return AppMsg(payload, refs, external=True)
 
     def root_spawn_info(self) -> SpawnInfo:
         return CrgcSpawnInfo(creator=None)
@@ -161,9 +161,10 @@ class CRGC(Engine):
     ) -> Optional[Any]:
         """(reference: CRGC.scala:114-127)"""
         if isinstance(msg, AppMsg):
-            if not state.can_record_message_received():
-                self.send_entry(state, is_busy=True)
-            state.record_message_received()
+            if not msg.external:
+                if not state.can_record_message_received():
+                    self.send_entry(state, is_busy=True)
+                state.record_message_received()
             return msg.payload
         return None
 
@@ -210,18 +211,79 @@ class CRGC(Engine):
     # Entry flushing
     # ----------------------------------------------------------------- #
 
-    def send_entry(self, state: CrgcState, is_busy: bool) -> None:
-        """(reference: CRGC.scala:179-193)"""
+    def _obtain_entry(self) -> Entry:
+        """Pop a pooled entry or allocate (reference: CRGC.scala:185-189)."""
         try:
             entry = self.entry_pool.popleft()
             allocated = False
         except IndexError:
             entry = Entry(self.crgc_context)
             allocated = True
-        state.flush_to_entry(is_busy, entry)
-        self.queue.append(entry)
         if events.recorder.enabled:
             events.recorder.commit(events.ENTRY_SEND, allocated_memory=allocated)
+        return entry
+
+    def send_entry(self, state: CrgcState, is_busy: bool) -> None:
+        """(reference: CRGC.scala:179-193)"""
+        entry = self._obtain_entry()
+        state.flush_to_entry(is_busy, entry)
+        self.queue.append(entry)
+
+    # ----------------------------------------------------------------- #
+    # Death accounting (divergence from the reference, deliberately)
+    # ----------------------------------------------------------------- #
+    # The reference's dying actors do not flush their remaining facts,
+    # relying on its forked mailbox hook's timing; an actor killed between
+    # a send and its flush would leave the recipient's receive balance
+    # permanently nonzero (a liveness leak).  We instead account death
+    # explicitly: drain-and-count the remaining mailbox, release carried
+    # refs, flush a final entry — and account post-mortem arrivals through
+    # the dead-letter hook, the single-node analogue of the reference's
+    # per-link admitted counts (reference: IngressEntry.java:91-100).
+
+    def pre_signal(self, signal: Any, state: CrgcState, ctx: "ActorContext") -> None:
+        from ...runtime.signals import _PostStop
+
+        if not isinstance(signal, _PostStop):
+            return
+        leftovers = ctx.cell.drain_mailbox()
+        app_msgs = [m for m in leftovers if isinstance(m, AppMsg)]
+        if app_msgs:
+            # They were never delivered to the user handler; count them in
+            # the system's dead-letter metric like any undelivered message.
+            self.system.record_dead_letters_dropped(ctx.cell, len(app_msgs))
+        for msg in app_msgs:
+            if not msg.external:
+                if not state.can_record_message_received():
+                    self.send_entry(state, is_busy=True)
+                state.record_message_received()
+            self.release(msg.refs, state, ctx)
+        # A stopped actor is no longer a root: without this, a dead root's
+        # final entry would leave its shadow a pseudoroot forever, leaking
+        # everything it still referenced.
+        state.is_root = False
+        self.send_entry(state, is_busy=False)
+
+    def on_dead_letter(self, cell: Any, msg: Any) -> None:
+        """Account an AppMsg that arrived after the recipient terminated:
+        one synthetic receive plus the release of every carried ref, folded
+        as an entry on the dead actor's behalf."""
+        if not isinstance(msg, AppMsg):
+            return
+        refs = list(msg.refs)
+        field_size = self.crgc_context.entry_field_size
+        first = True
+        while first or refs:
+            entry = self._obtain_entry()
+            entry.self_ref = CrgcRefob(cell)
+            entry.recv_count = 1 if first else 0
+            batch, refs = refs[:field_size], refs[field_size:]
+            for i, ref in enumerate(batch):
+                ref.deactivate()
+                entry.updated_refs[i] = ref
+                entry.updated_infos[i] = ref.info
+            self.queue.append(entry)
+            first = False
 
     # ----------------------------------------------------------------- #
 

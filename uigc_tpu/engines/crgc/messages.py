@@ -12,12 +12,19 @@ class AppMsg(GCMessage):
     ``window_id`` is stamped by the egress when the message crosses a node
     boundary (reference: GCMessage.scala:7-13, Gateways.scala:83)."""
 
-    __slots__ = ("payload", "_refs", "window_id")
+    __slots__ = ("payload", "_refs", "window_id", "external")
 
-    def __init__(self, payload: Any, refs: Iterable[Refob]):
+    def __init__(self, payload: Any, refs: Iterable[Refob], external: bool = False):
         self.payload = payload
         self._refs: Tuple[Refob, ...] = tuple(refs)
         self.window_id = -1
+        #: True for messages wrapped by the root adapter (sent by
+        #: unmanaged code).  External sends carry no sender-side
+        #: send-count, so counting them as received would leave the
+        #: recipient's receive balance permanently nonzero — the reference
+        #: tolerates this because it never collects root shadows at all;
+        #: we skip the count so dead roots' shadows can be reclaimed.
+        self.external = external
 
     @property
     def refs(self) -> Tuple[Refob, ...]:

@@ -141,6 +141,16 @@ class Engine:
         pass-through."""
         return None
 
+    # -- Dead letters -------------------------------------------------- #
+
+    def on_dead_letter(self, cell: "ActorCell", msg: Any) -> None:
+        """Called when a message is delivered to a terminated actor.
+
+        No reference analogue as an SPI hook; engines that track message
+        balances (CRGC) use this to account undelivered sends the way the
+        reference's ingress stages account admitted messages across node
+        boundaries (reference: IngressEntry.java:91-100)."""
+
     # -- Shutdown ------------------------------------------------------ #
 
     def shutdown(self) -> None:

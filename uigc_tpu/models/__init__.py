@@ -1,0 +1,3 @@
+from .graphgen import powerlaw_actor_graph, ring_graph
+
+__all__ = ["powerlaw_actor_graph", "ring_graph"]

@@ -1,0 +1,8 @@
+from .sharded_trace import (
+    build_mesh,
+    make_sharded_fold,
+    make_sharded_trace,
+    shard_graph,
+)
+
+__all__ = ["build_mesh", "make_sharded_fold", "make_sharded_trace", "shard_graph"]

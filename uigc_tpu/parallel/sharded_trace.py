@@ -1,0 +1,238 @@
+"""Multi-device shadow-graph trace: shard_map over a device mesh.
+
+The TPU-native replacement for the reference's node-level sharding, where
+each cluster node's collector owns a shadow-graph replica and gossips
+DeltaGraphs to every peer (reference: LocalGC.scala:191-196).  On a TPU
+slice we instead *partition* the graph across devices and let XLA
+collectives do the replication work per trace wave:
+
+- node feature arrays are sharded by slot range (axis "gc");
+- propagation pairs (ref edges with positive weight, plus supervisor
+  pointers re-encoded as edges) are sharded by *destination*, so each
+  device's scatter lands only in its own node shard;
+- the mark vector is rebuilt each wave by ``all_gather`` over ICI, which
+  is the collective analogue of the DeltaMsg broadcast;
+- convergence is decided with a global ``psum`` of per-shard change bits.
+
+The fold step (scatter-adding a batch of entry deltas into the sharded
+arrays) rides the same mesh: deltas are bucketed by destination shard on
+the host, then scatter-added device-side.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def build_mesh(n_devices: int, axis: str = "gc"):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def pad_to(x: np.ndarray, size: int, fill=0) -> np.ndarray:
+    out = np.full(size, fill, dtype=x.dtype)
+    out[: x.shape[0]] = x
+    return out
+
+
+def shard_graph(
+    graph: Dict[str, np.ndarray], n_devices: int
+) -> Dict[str, np.ndarray]:
+    """Repack kernel arrays for an n-device mesh.
+
+    Nodes are padded to a multiple of n_devices and sharded by contiguous
+    slot range.  Propagation pairs (positive-weight edges + supervisor
+    pointers) are bucketed by destination shard and padded to equal bucket
+    sizes, yielding [n_devices, m] arrays sharded on the leading axis.
+    """
+    n = graph["flags"].shape[0]
+    n_pad = ((n + n_devices - 1) // n_devices) * n_devices
+
+    flags = pad_to(graph["flags"], n_pad)
+    recv = pad_to(graph["recv_count"], n_pad)
+
+    live = graph["edge_weight"] > 0
+    esrc = graph["edge_src"][live]
+    edst = graph["edge_dst"][live]
+    sup = graph["supervisor"]
+    sup_src = np.nonzero(sup >= 0)[0].astype(np.int32)
+    sup_dst = sup[sup_src].astype(np.int32)
+
+    # Supervisor pointers become propagation pairs like the reference's
+    # supervisor marking (reference: ShadowGraph.java:242-267).
+    psrc = np.concatenate([esrc, sup_src])
+    pdst = np.concatenate([edst, sup_dst])
+
+    shard_size = n_pad // n_devices
+    owner = pdst // shard_size
+
+    buckets_src = []
+    buckets_dst = []
+    max_m = 1
+    for d in range(n_devices):
+        sel = owner == d
+        buckets_src.append(psrc[sel])
+        buckets_dst.append(pdst[sel])
+        max_m = max(max_m, int(sel.sum()))
+    # Pad buckets with a self-loop on the sink (src = n_pad, handled by
+    # the kernel's padded mark vector).
+    src2 = np.full((n_devices, max_m), n_pad, dtype=np.int32)
+    dst2 = np.full((n_devices, max_m), 0, dtype=np.int32)
+    for d in range(n_devices):
+        m = buckets_src[d].shape[0]
+        src2[d, :m] = buckets_src[d]
+        # local destination index within the shard
+        dst2[d, :m] = buckets_dst[d] - d * shard_size
+
+    return {
+        "flags": flags,
+        "recv_count": recv,
+        "pair_src": src2,
+        "pair_dst": dst2,
+        "n_pad": n_pad,
+        "shard_size": shard_size,
+    }
+
+
+def make_sharded_trace(mesh, axis: str = "gc"):
+    """Build the jitted multi-device trace step over ``mesh``.
+
+    Returns fn(flags, recv_count, pair_src, pair_dst) -> mark (bool[n_pad])
+    with flags/recv sharded by node range and pair arrays sharded on their
+    leading device axis.
+    """
+    jax, jnp = _jax()
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_devices = mesh.devices.size
+    F = __import__("uigc_tpu.ops.trace", fromlist=["trace"])
+
+    def local_trace(flags, recv, pair_src, pair_dst):
+        # flags/recv: [shard_size] local node shard
+        # pair_src:   [1, m] global source ids of pairs targeting this shard
+        # pair_dst:   [1, m] local destination ids
+        flags = flags.reshape(-1)
+        recv = recv.reshape(-1)
+        pair_src = pair_src.reshape(-1)
+        pair_dst = pair_dst.reshape(-1)
+        shard_size = flags.shape[0]
+
+        in_use = (flags & F.FLAG_IN_USE) != 0
+        halted = (flags & F.FLAG_HALTED) != 0
+        seed = (
+            ((flags & F.FLAG_ROOT) != 0)
+            | ((flags & F.FLAG_BUSY) != 0)
+            | (recv != 0)
+            | ((flags & F.FLAG_INTERNED) == 0)
+        )
+        local_mark = in_use & (~halted) & seed
+
+        # Replicated view needed for gathers by global source id.
+        halted_all = jax.lax.all_gather(halted, axis).reshape(-1)
+
+        def cond(carry):
+            _, changed = carry
+            return changed
+
+        def body(carry):
+            local_mark, _ = carry
+            mark_all = jax.lax.all_gather(local_mark, axis).reshape(-1)
+            mark_all = jnp.concatenate([mark_all, jnp.zeros((1,), bool)])
+            halted_pad = jnp.concatenate([halted_all, jnp.zeros((1,), bool)])
+            src_active = mark_all[pair_src] & (~halted_pad[pair_src])
+            prop = (
+                jnp.zeros((shard_size,), jnp.int32)
+                .at[pair_dst]
+                .max(src_active.astype(jnp.int32))
+            )
+            new_local = local_mark | ((prop > 0) & in_use)
+            changed_local = jnp.any(new_local != local_mark)
+            changed = jax.lax.psum(changed_local.astype(jnp.int32), axis) > 0
+            return new_local, changed
+
+        local_mark, _ = jax.lax.while_loop(
+            cond, body, (local_mark, jnp.array(True))
+        )
+        return local_mark.reshape(1, -1)
+
+    spec_nodes = P(axis)
+    spec_pairs = P(axis, None)
+
+    fn = shard_map(
+        local_trace,
+        mesh=mesh,
+        in_specs=(spec_nodes, spec_nodes, spec_pairs, spec_pairs),
+        out_specs=spec_pairs,
+    )
+
+    @jax.jit
+    def traced(flags, recv, pair_src, pair_dst):
+        return fn(flags, recv, pair_src, pair_dst).reshape(-1)
+
+    return traced
+
+
+def make_sharded_fold(mesh, axis: str = "gc"):
+    """Build the jitted multi-device fold step: scatter a batch of entry
+    deltas (recv-count deltas + flag overwrites, bucketed by node shard on
+    host) into the sharded node arrays.  The device-side analogue of
+    mergeEntry's node updates (reference: ShadowGraph.java:75-83).
+
+    Contract: slots within one batch must be UNIQUE per shard — the host
+    bucketing must pre-combine multiple entries for the same actor (sum
+    recv deltas, keep the last flag set/clear pair), because the flag
+    scatter reads the pre-batch value once and duplicate-index scatter
+    order is undefined.  recv uses `.at[].add` and would compose, but the
+    flag path would not."""
+    jax, jnp = _jax()
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local_fold(flags, recv, slot, recv_delta, flag_set, flag_clear):
+        flags = flags.reshape(-1)
+        recv = recv.reshape(-1)
+        slot = slot.reshape(-1)  # local slot ids, padded with shard_size
+        recv_delta = recv_delta.reshape(-1)
+        flag_set = flag_set.reshape(-1)
+        flag_clear = flag_clear.reshape(-1)
+        size = flags.shape[0]
+        flags_pad = jnp.concatenate([flags, jnp.zeros((1,), flags.dtype)])
+        recv_pad = jnp.concatenate([recv, jnp.zeros((1,), recv.dtype)])
+        recv_pad = recv_pad.at[slot].add(recv_delta)
+        old = flags_pad[slot]
+        flags_pad = flags_pad.at[slot].set((old | flag_set) & (~flag_clear))
+        return flags_pad[:size].reshape(1, -1), recv_pad[:size].reshape(1, -1)
+
+    fn = shard_map(
+        local_fold,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis, None), P(axis, None), P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None), P(axis, None)),
+    )
+
+    @jax.jit
+    def fold(flags, recv, slot, recv_delta, flag_set, flag_clear):
+        f2, r2 = fn(flags, recv, slot, recv_delta, flag_set, flag_clear)
+        return f2.reshape(-1), r2.reshape(-1)
+
+    return fold

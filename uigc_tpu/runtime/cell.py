@@ -367,6 +367,15 @@ class ActorCell:
     # Watch / misc
     # ------------------------------------------------------------------ #
 
+    def drain_mailbox(self) -> list:
+        """Atomically remove and return all pending application messages.
+        Used by engines during PostStop to account undelivered messages
+        (the death-accounting path)."""
+        with self._lock:
+            msgs = list(self._mailbox)
+            self._mailbox.clear()
+        return msgs
+
     def watch(self, other: "ActorCell") -> None:
         """Subscribe to ``other``'s termination (Akka's ``context.watch``;
         the reference's MAC engine watches children, MAC.scala:161)."""

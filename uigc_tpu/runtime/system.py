@@ -188,6 +188,9 @@ class ActorSystem:
 
     def record_dead_letter(self, cell: ActorCell, msg: Any) -> None:
         self.dead_letters += 1
+        engine = getattr(self, "engine", None)
+        if engine is not None:
+            engine.on_dead_letter(cell, msg)
 
     def record_dead_letters_dropped(self, cell: ActorCell, count: int) -> None:
         self.dead_letters += count
