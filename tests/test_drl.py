@@ -1,0 +1,184 @@
+"""DRL engine: reference-listing lifecycle.
+
+The reference's DRL engine is dead code (not selectable,
+UIGC.scala:14-18); here it is a first-class engine, so it gets the same
+lifecycle coverage as the others: spawn / ref sharing / release-with-
+created-refs reconciliation / pending self-message detection.
+"""
+
+from uigc_tpu import AbstractBehavior, ActorTestKit, Behaviors, Message, NoRefs, PostStop
+
+CONFIG = {"uigc.engine": "drl"}
+
+
+class GetRef(Message):
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,)
+
+
+class Hello(NoRefs):
+    def __eq__(self, other):
+        return isinstance(other, Hello)
+
+    def __hash__(self):
+        return hash("Hello")
+
+
+class SendC(NoRefs):
+    def __init__(self, msg):
+        self.msg = msg
+
+
+class SendB(NoRefs):
+    def __init__(self, msg):
+        self.msg = msg
+
+
+class TellBAboutC(NoRefs):
+    pass
+
+
+class ReleaseC(NoRefs):
+    def __eq__(self, other):
+        return isinstance(other, ReleaseC)
+
+    def __hash__(self):
+        return hash("ReleaseC")
+
+
+class ReleaseB(NoRefs):
+    pass
+
+
+class Countdown(NoRefs):
+    def __init__(self, n):
+        self.n = n
+
+
+class StartCountdown(NoRefs):
+    def __init__(self, n):
+        self.n = n
+
+
+class Stopped(NoRefs):
+    def __init__(self, name=None):
+        self.name = name
+
+    def __eq__(self, other):
+        return isinstance(other, Stopped)
+
+    def __hash__(self):
+        return hash("Stopped")
+
+
+class ActorB(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        self.actor_c = None
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, GetRef):
+            self.actor_c = msg.ref
+        elif isinstance(msg, SendC):
+            self.actor_c.tell(msg.msg, ctx)
+        elif isinstance(msg, ReleaseC):
+            ctx.release(self.actor_c)
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(Stopped())
+        return None
+
+
+class ActorC(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        self.count = 0
+
+    def on_message(self, msg):
+        if isinstance(msg, Hello):
+            self.probe.ref.tell(Hello())
+        elif isinstance(msg, Countdown):
+            self.count += 1
+            if msg.n > 0:
+                self.context.self.tell(Countdown(msg.n - 1), self.context)
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(Stopped())
+        return None
+
+
+class ActorA(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        self.actor_b = context.spawn(
+            Behaviors.setup(lambda c: ActorB(c, probe)), "actorB"
+        )
+        self.actor_c = context.spawn(
+            Behaviors.setup(lambda c: ActorC(c, probe)), "actorC"
+        )
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, TellBAboutC):
+            self.actor_b.tell(GetRef(ctx.create_ref(self.actor_c, self.actor_b)), ctx)
+        elif isinstance(msg, SendB):
+            self.actor_b.tell(msg.msg, ctx)
+        elif isinstance(msg, SendC):
+            self.actor_c.tell(msg.msg, ctx)
+        elif isinstance(msg, ReleaseC):
+            ctx.release(self.actor_c)
+        elif isinstance(msg, ReleaseB):
+            ctx.release(self.actor_b)
+        elif isinstance(msg, StartCountdown):
+            self.actor_c.tell(Countdown(msg.n), ctx)
+            ctx.release(self.actor_c)
+        return self
+
+
+def test_drl_shared_ref_lifecycle():
+    kit = ActorTestKit(CONFIG)
+    try:
+        probe = kit.create_test_probe()
+        root = kit.spawn(Behaviors.setup_root(lambda c: ActorA(c, probe)), "root")
+        root.tell(TellBAboutC())
+        root.tell(SendB(SendC(Hello())))
+        probe.expect_message(Hello())
+
+        # C has two owners; releasing one must not kill it.
+        root.tell(ReleaseC())
+        probe.expect_no_message(0.3)
+        root.tell(SendB(SendC(Hello())))
+        probe.expect_message(Hello())
+
+        # Last owner releases: C terminates.
+        root.tell(SendB(ReleaseC()))
+        probe.expect_message(Stopped())
+
+        # Releasing B terminates B.
+        root.tell(ReleaseB())
+        probe.expect_message(Stopped())
+    finally:
+        kit.shutdown()
+
+
+def test_drl_pending_self_messages():
+    kit = ActorTestKit(CONFIG)
+    try:
+        probe = kit.create_test_probe(timeout_s=30.0)
+        root = kit.spawn(Behaviors.setup_root(lambda c: ActorA(c, probe)), "root")
+        root.tell(StartCountdown(2000))
+        probe.expect_message(Stopped())  # C, only after the countdown drains
+    finally:
+        kit.shutdown()

@@ -1,0 +1,126 @@
+"""Wire-format round trips for cross-node batches.
+
+Analogue of the reference's SerializationSpec (reference:
+src/test/scala/.../crgc/SerializationSpec.scala:10-126): DeltaShadow,
+DeltaGraph (built through a real State -> Entry -> DeltaGraph pipeline),
+and IngressEntry round-trip through their binary encodings.
+"""
+
+import pytest
+
+from uigc_tpu.engines.crgc.delta import DeltaGraph, DeltaShadow
+from uigc_tpu.engines.crgc.gateways import IngressEntry
+from uigc_tpu.engines.crgc.refob import CrgcRefob
+from uigc_tpu.engines.crgc.state import CrgcContext, CrgcState, Entry
+
+
+class FakeSystem:
+    address = "uigc://ser"
+
+
+class FakeCell:
+    _count = 0
+
+    def __init__(self):
+        FakeCell._count += 1
+        self.uid = FakeCell._count
+        self.path = f"/ser/{self.uid}"
+        self.system = FakeSystem()
+
+
+class Registry:
+    """Cell <-> bytes codec standing in for actor-ref serialization."""
+
+    def __init__(self):
+        self.by_id = {}
+
+    def encode(self, cell):
+        self.by_id[cell.uid] = cell
+        return str(cell.uid).encode()
+
+    def decode(self, data):
+        return self.by_id[int(data.decode())]
+
+
+def test_delta_shadow_roundtrip():
+    shadow = DeltaShadow()
+    shadow.recv_count = -7
+    shadow.supervisor = 3
+    shadow.interned = True
+    shadow.is_root = False
+    shadow.is_busy = True
+    shadow.outgoing = {0: 2, 5: -1}
+    data = shadow.serialize()
+    back, offset = DeltaShadow.deserialize(data, 0)
+    assert offset == len(data)
+    assert back == shadow
+
+    # Empty shadow, like the reference's 13-byte case.
+    empty = DeltaShadow()
+    data = empty.serialize()
+    back, offset = DeltaShadow.deserialize(data, 0)
+    assert offset == len(data)
+    assert back == empty
+
+
+def test_delta_graph_roundtrip_via_state_pipeline():
+    """Build entries through the real State machinery, fold into a
+    DeltaGraph, round-trip it (reference: SerializationSpec.scala:85-97)."""
+    context = CrgcContext(delta_graph_size=64, entry_field_size=4)
+    registry = Registry()
+
+    a, b, c = FakeCell(), FakeCell(), FakeCell()
+    ref_a, ref_b, ref_c = CrgcRefob(a), CrgcRefob(b), CrgcRefob(c)
+
+    state = CrgcState(ref_a, context)
+    state.record_new_refob(ref_a, ref_a)
+    state.record_new_refob(ref_a, ref_b)
+    state.record_new_actor(ref_c)
+    ref_b.inc_send_count()
+    state.record_updated_refob(ref_b)
+    state.record_message_received()
+
+    entry = Entry(context)
+    state.flush_to_entry(is_busy=True, entry=entry)
+
+    graph = DeltaGraph(FakeSystem.address, context)
+    graph.merge_entry(entry)
+    assert graph.non_empty()
+
+    data = graph.serialize(registry.encode)
+    back = DeltaGraph.deserialize(data, context, registry.decode)
+    assert back == graph
+    assert back.decoder() == graph.decoder()
+
+
+def test_delta_graph_fills_and_reports():
+    context = CrgcContext(delta_graph_size=16, entry_field_size=2)
+    graph = DeltaGraph("x", context)
+    cells = [FakeCell() for _ in range(12)]
+    for cell in cells:
+        entry = Entry(context)
+        entry.self_ref = CrgcRefob(cell)
+        entry.recv_count = 1
+        graph.merge_entry(entry)
+        if graph.is_full():
+            break
+    assert graph.is_full()
+
+
+def test_ingress_entry_roundtrip():
+    registry = Registry()
+    entry = IngressEntry()
+    entry.id = 42
+    entry.is_final = True
+    entry.egress_address = "uigc://a"
+    entry.ingress_address = "uigc://b"
+    x, y, z = FakeCell(), FakeCell(), FakeCell()
+    entry.on_message(x, [CrgcRefob(y), CrgcRefob(z), CrgcRefob(y)])
+    entry.on_message(x, [])
+    entry.on_message(z, [CrgcRefob(x)])
+
+    data = entry.serialize(registry.encode)
+    back = IngressEntry.deserialize(data, registry.decode)
+    assert back == entry
+    assert back.admitted[x].message_count == 2
+    assert back.admitted[x].created_refs[y] == 2

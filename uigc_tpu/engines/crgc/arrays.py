@@ -212,6 +212,56 @@ class ArrayShadowGraph:
             if not refob_info.is_active(info):
                 self._update_edge(self_slot, target_slot, -1)
 
+    def merge_delta(self, delta) -> None:
+        """Fold a peer node's compressed batch
+        (reference: ShadowGraph.java:127-156)."""
+        decoder = delta.decoder()
+        slots = [self.slot_for(cell) for cell in decoder]
+        for i, delta_shadow in enumerate(delta.shadows):
+            slot = slots[i]
+            if delta_shadow.interned:
+                self.flags[slot] |= _F.FLAG_INTERNED
+                if delta_shadow.is_busy:
+                    self.flags[slot] |= _F.FLAG_BUSY
+                else:
+                    self.flags[slot] &= ~_F.FLAG_BUSY
+                if delta_shadow.is_root:
+                    self.flags[slot] |= _F.FLAG_ROOT
+                else:
+                    self.flags[slot] &= ~_F.FLAG_ROOT
+            self.recv_count[slot] += delta_shadow.recv_count
+            if delta_shadow.supervisor >= 0:
+                self.supervisor[slot] = slots[delta_shadow.supervisor]
+            for target_id, count in delta_shadow.outgoing.items():
+                self._update_edge(slot, slots[target_id], count)
+
+    def merge_undo_log(self, log) -> None:
+        """Halt a dead node's actors and revert its unadmitted effects
+        (reference: ShadowGraph.java:158-174).
+
+        The worklist grows while folding: applying admitted created-refs
+        can intern previously-unknown target actors, and those must also
+        be visited (halted if they lived on the dead node) — the oracle
+        gets this by iterating its live from_set list, which visits
+        shadows appended mid-fold."""
+        cells = list(self.slot_of.keys())
+        seen = set(cells)
+        i = 0
+        while i < len(cells):
+            cell = cells[i]
+            i += 1
+            slot = self.slot_of[cell]
+            if self.locations[slot] == log.node_address:
+                self.flags[slot] |= _F.FLAG_HALTED
+            field = log.admitted.get(cell)
+            if field is not None:
+                self.recv_count[slot] += field.message_count
+                for target_cell, count in field.created_refs.items():
+                    if target_cell not in seen:
+                        seen.add(target_cell)
+                        cells.append(target_cell)
+                    self._update_edge(slot, self.slot_for(target_cell), count)
+
     # ------------------------------------------------------------- #
     # Trace + sweep (reference: ShadowGraph.java:205-289)
     # ------------------------------------------------------------- #

@@ -3,16 +3,31 @@
 Mirrors the reference's ``LocalGC`` (reference: crgc/LocalGC.scala:48-282):
 a system actor on a pinned thread that periodically drains the mutator
 entry queue, folds entries into its shadow graph, and runs the liveness
-trace.  Multi-node concerns (delta broadcast, ingress entries, undo logs,
-membership gating) are layered on in ``fabric``-aware subclasses/methods.
+trace.  Multi-node (num-nodes > 1, attached to a Fabric):
+
+- GC is gated until all ``num-nodes`` members join
+  (reference: LocalGC.scala:69-75,206-208);
+- drained entries are additionally folded into a DeltaGraph that is
+  broadcast to every peer collector when full
+  (reference: LocalGC.scala:159-165,191-196);
+- per-link ingress entries are merged into undo logs and re-broadcast to
+  the other peers (reference: LocalGC.scala:100-122,245-268);
+- on member removal, the matching ingress finalizes, and once every
+  surviving peer's final entry arrives (the quorum), the undo log is
+  folded: the dead node's actors halt and its unadmitted effects revert
+  (reference: LocalGC.scala:228-243,251-266).
 """
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Any
+from typing import TYPE_CHECKING, Any, Dict, Set
 
 from ...runtime.behaviors import RawBehavior
+from ...runtime.fabric import MemberRemoved, MemberUp
 from ...utils import events
+from .delta import DeltaGraph
+from .gateways import IngressEntry
+from .undo import UndoLog
 
 if TYPE_CHECKING:  # pragma: no cover
     from .engine import CRGC
@@ -32,29 +47,84 @@ class _StartWave:
         return "StartWave"
 
 
+class _FinalizeEgresses:
+    __slots__ = ()
+
+
 WAKEUP = _Wakeup()
 START_WAVE = _StartWave()
+FINALIZE_EGRESSES = _FinalizeEgresses()
+
+
+class DeltaMsg:
+    """(reference: LocalGC.scala:26-28)"""
+
+    __slots__ = ("seqnum", "graph")
+
+    def __init__(self, seqnum: int, graph: DeltaGraph):
+        self.seqnum = seqnum
+        self.graph = graph
+
+
+class LocalIngressEntry:
+    """(reference: LocalGC.scala:16)"""
+
+    __slots__ = ("entry",)
+
+    def __init__(self, entry: IngressEntry):
+        self.entry = entry
+
+
+class RemoteIngressEntry:
+    """(reference: LocalGC.scala:35-37)"""
+
+    __slots__ = ("entry",)
+
+    def __init__(self, entry: IngressEntry):
+        self.entry = entry
 
 
 class Bookkeeper(RawBehavior):
-    """Single-node collector loop (reference: LocalGC.scala:144-189)."""
+    """Collector loop (reference: LocalGC.scala:48-282)."""
 
     def __init__(self, engine: "CRGC"):
         self.engine = engine
         self.cell: Any = None
         self.total_entries = 0
+        self.started = False
         self._timer_keys: list = []
         self.shadow_graph = engine.make_shadow_graph()
+        # Multi-node state (reference: LocalGC.scala:59-67).
+        self.remote_gcs: Dict[str, Any] = {}  # address -> peer Bookkeeper cell
+        self.undo_logs: Dict[str, UndoLog] = {}
+        self.downed_gcs: Set[str] = set()
+        #: dead nodes whose undo log has already been folded (folding is
+        #: not idempotent, so exactly-once matters)
+        self.undone_gcs: Set[str] = set()
+        self.delta_graph_id = 0
+        self.delta_graph = DeltaGraph(engine.system.address, engine.crgc_context)
+
+    @property
+    def multi_node(self) -> bool:
+        return self.engine.num_nodes > 1
 
     # Bound by spawn_system_raw before the first batch runs.
     def bind(self, cell: Any) -> None:
         self.cell = cell
-        self.start()
+        if not self.multi_node:
+            self.start()
+        else:
+            fabric = self.engine.system.fabric
+            if fabric is None:
+                raise RuntimeError(
+                    "uigc.crgc.num-nodes > 1 requires the system to be "
+                    "attached to a Fabric"
+                )
+            fabric.subscribe(cell)
 
     def start(self) -> None:
-        """Begin periodic collection (reference: LocalGC.scala:211-226).
-        Single-node systems start immediately; multi-node systems call this
-        once membership is complete."""
+        """Begin periodic collection (reference: LocalGC.scala:211-226)."""
+        self.started = True
         timers = self.engine.system.timers
         wakeup_s = self.engine.wakeup_interval_ms / 1000.0
         key = ("crgc-wakeup", id(self))
@@ -67,21 +137,137 @@ class Bookkeeper(RawBehavior):
             timers.schedule_fixed_delay(
                 wave_s, lambda: self.cell.tell(START_WAVE), key=key
             )
+        if self.multi_node:
+            fin_s = self.engine.egress_finalize_interval_ms / 1000.0
+            key = ("crgc-egress-finalize", id(self))
+            self._timer_keys.append(key)
+            timers.schedule_fixed_delay(
+                fin_s, lambda: self.cell.tell(FINALIZE_EGRESSES), key=key
+            )
 
     def on_message(self, msg: Any) -> Any:
         if isinstance(msg, _Wakeup):
-            self.collect()
+            if self.started:
+                self.collect()
         elif isinstance(msg, _StartWave):
             self.shadow_graph.start_wave()
+        elif isinstance(msg, _FinalizeEgresses):
+            # (reference: LocalGC.scala:219-224, via ForwardToEgress)
+            fabric = self.engine.system.fabric
+            for addr in list(self.remote_gcs):
+                fabric.finalize_egress(self.engine.system, addr)
+        elif isinstance(msg, MemberUp):
+            self.add_member(msg.address)
+        elif isinstance(msg, MemberRemoved):
+            self.remove_member(msg.address)
+        elif isinstance(msg, DeltaMsg):
+            self.handle_delta(msg.graph)
+        elif isinstance(msg, LocalIngressEntry):
+            self.handle_local_ingress_entry(msg.entry)
+        elif isinstance(msg, RemoteIngressEntry):
+            with events.recorder.timed(events.MERGING_INGRESS_ENTRIES):
+                self.merge_ingress_entry(msg.entry)
         return None
 
+    # ------------------------------------------------------------- #
+    # Membership (reference: LocalGC.scala:198-243)
+    # ------------------------------------------------------------- #
+
+    def add_member(self, address: str) -> None:
+        if address == self.engine.system.address or not self.multi_node:
+            return
+        fabric = self.engine.system.fabric
+        peer_system = fabric.systems.get(address)
+        if peer_system is None:
+            return
+        self.remote_gcs[address] = peer_system.engine.bookkeeper_cell
+        if address not in self.undo_logs:
+            self.undo_logs[address] = UndoLog(address)
+        # Establish both link directions eagerly (the Artery-handshake
+        # analogue) so crash-time finalization always has an ingress,
+        # even for pairs that never exchanged app messages.
+        fabric.link(self.engine.system, peer_system)
+        fabric.link(peer_system, self.engine.system)
+        if not self.started and len(self.remote_gcs) + 1 == self.engine.num_nodes:
+            self.start()
+
+    def remove_member(self, address: str) -> None:
+        """(reference: LocalGC.scala:228-243)"""
+        if address == self.engine.system.address:
+            return
+        self.downed_gcs.add(address)
+        self.remote_gcs.pop(address, None)
+        # Finalize the ingress for the dead link (the NewIngressActor hook
+        # in the reference, Gateways.scala:129).
+        fabric = self.engine.system.fabric
+        for link in fabric.ingress_links_to(self.engine.system):
+            if link.src.address == address and link.ingress is not None:
+                with link.lock:
+                    link.ingress.finalize_and_send(is_final=True)
+        # Membership shrank, so quorums that were waiting on the removed
+        # node may now be satisfiable — re-check every pending undo log.
+        # (The reference only checks on is_final arrival,
+        # LocalGC.scala:251-266, which stalls under a second crash.)
+        for downed in list(self.downed_gcs):
+            self._maybe_fold_undo_log(downed)
+
+    # ------------------------------------------------------------- #
+    # Peer traffic (reference: LocalGC.scala:100-142)
+    # ------------------------------------------------------------- #
+
+    def handle_delta(self, graph: DeltaGraph) -> None:
+        if graph.address in self.remote_gcs:
+            with events.recorder.timed(events.MERGING_DELTA_GRAPHS):
+                # Only merge from nodes that have not been removed.
+                self.shadow_graph.merge_delta(graph)
+                self.undo_logs[graph.address].merge_delta_graph(graph)
+
+    def handle_local_ingress_entry(self, entry: IngressEntry) -> None:
+        # Tell every remote GC except the one adjacent to this entry.
+        for addr, gc in self.remote_gcs.items():
+            if addr != entry.egress_address:
+                gc.tell(RemoteIngressEntry(entry))
+        with events.recorder.timed(events.MERGING_INGRESS_ENTRIES):
+            self.merge_ingress_entry(entry)
+
+    def merge_ingress_entry(self, entry: IngressEntry) -> None:
+        """(reference: LocalGC.scala:245-268)"""
+        addr = entry.egress_address
+        log = self.undo_logs.get(addr)
+        if log is None:
+            log = UndoLog(addr)
+            self.undo_logs[addr] = log
+        log.merge_ingress_entry(entry)
+        if entry.is_final:
+            self._maybe_fold_undo_log(addr)
+
+    def _maybe_fold_undo_log(self, addr: str) -> None:
+        """Fold the dead node's undo log exactly once, when our own final
+        entry and every surviving peer's are in (the finalization quorum,
+        reference: LocalGC.scala:251-266)."""
+        if addr in self.undone_gcs:
+            return
+        log = self.undo_logs.get(addr)
+        if log is None:
+            return
+        my_addr = self.engine.system.address
+        if my_addr in log.finalized_by and all(
+            peer in log.finalized_by for peer in self.remote_gcs
+        ):
+            self.undone_gcs.add(addr)
+            self.shadow_graph.merge_undo_log(log)
+            self.shadow_graph.trace(should_kill=True)
+
+    # ------------------------------------------------------------- #
+    # Collection (reference: LocalGC.scala:144-196)
+    # ------------------------------------------------------------- #
+
     def collect(self) -> int:
-        """One collection pass: drain, fold, trace
-        (reference: LocalGC.scala:144-185)."""
         engine = self.engine
         queue = engine.queue
         pool = engine.entry_pool
         count = 0
+        multi = self.multi_node
         with events.recorder.timed(events.PROCESSING_ENTRIES) as ev:
             while True:
                 try:
@@ -90,12 +276,25 @@ class Bookkeeper(RawBehavior):
                     break
                 count += 1
                 self.shadow_graph.merge_entry(entry)
+                if multi:
+                    self.delta_graph.merge_entry(entry)
+                    if self.delta_graph.is_full():
+                        self.finalize_delta_graph()
                 entry.clean()
                 pool.append(entry)
+            if multi and self.delta_graph.non_empty():
+                self.finalize_delta_graph()
             ev.fields["num_entries"] = count
         self.total_entries += count
         self.shadow_graph.trace(should_kill=True)
         return count
+
+    def finalize_delta_graph(self) -> None:
+        """(reference: LocalGC.scala:191-196)"""
+        for gc in self.remote_gcs.values():
+            gc.tell(DeltaMsg(self.delta_graph_id, self.delta_graph))
+        self.delta_graph_id += 1
+        self.delta_graph = DeltaGraph(self.engine.system.address, self.engine.crgc_context)
 
     def stop_timers(self) -> None:
         for key in self._timer_keys:

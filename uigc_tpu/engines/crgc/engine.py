@@ -51,6 +51,9 @@ class CRGC(Engine):
         self.num_nodes = config.get_int("uigc.crgc.num-nodes")
         self.wakeup_interval_ms = config.get_int("uigc.crgc.wakeup-interval")
         self.wave_frequency_ms = config.get_int("uigc.crgc.wave-frequency")
+        self.egress_finalize_interval_ms = config.get_int(
+            "uigc.crgc.egress-finalize-interval"
+        )
         self.shadow_graph_impl = config.get_string("uigc.crgc.shadow-graph")
 
         # Mutator->collector channel + entry free list.  CPython deque
@@ -154,7 +157,15 @@ class CRGC(Engine):
             self.send_entry(state, is_busy=True)
         ref.inc_send_count()
         state.record_updated_refob(ref)
-        ref.target.tell(AppMsg(msg, refs))
+        app_msg = AppMsg(msg, refs)
+        target = ref.target
+        fabric = self.system.fabric
+        if fabric is not None and target.system is not self.system:
+            # Cross-node send: route through the link's egress/ingress
+            # interceptors (reference: streams/Egress.scala:19-20).
+            fabric.deliver(self.system, target, app_msg)
+        else:
+            target.tell(app_msg)
 
     def on_message(
         self, msg: GCMessage, state: CrgcState, ctx: "ActorContext"
@@ -230,6 +241,20 @@ class CRGC(Engine):
         self.queue.append(entry)
 
     # ----------------------------------------------------------------- #
+    # Remoting interception (reference: CRGC.scala:223-241)
+    # ----------------------------------------------------------------- #
+
+    def spawn_egress(self, link: Any) -> Any:
+        from .gateways import Egress
+
+        return Egress(link)
+
+    def spawn_ingress(self, link: Any) -> Any:
+        from .gateways import Ingress
+
+        return Ingress(link, self)
+
+    # ----------------------------------------------------------------- #
     # Death accounting (divergence from the reference, deliberately)
     # ----------------------------------------------------------------- #
     # The reference's dying actors do not flush their remaining facts,
@@ -289,3 +314,10 @@ class CRGC(Engine):
 
     def shutdown(self) -> None:
         self.bookkeeper.stop_timers()
+
+    def on_crash(self) -> None:
+        self.bookkeeper.stop_timers()
+        # Stop the collector cell: the stop rides the system-message
+        # channel, so pending membership events are never processed —
+        # an abrupt death, not a graceful leave.
+        self.bookkeeper_cell.stop()

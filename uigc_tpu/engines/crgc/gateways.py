@@ -1,0 +1,209 @@
+"""Per-link gateways: admitted-message/ref accounting at node boundaries.
+
+Mirrors the reference's Artery stream-stage interceptors (reference:
+crgc/Gateways.scala:15-191, crgc/IngressEntry.java:12-158): the egress of
+each link stamps outbound AppMsgs with its current window and tallies
+them; the ingress tallies what was actually admitted.  When the egress's
+window-boundary marker arrives (pushed in-stream, so FIFO with app
+messages), the ingress finalizes its own entry and hands it to the local
+collector.  These admitted-counts are what make node-crash recovery
+possible: the undo log reverts exactly the dead node's *unadmitted*
+claims (reference: UndoLog.java:39-93).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Dict, Iterable, Optional
+
+from ...utils import events
+from .messages import AppMsg
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ...runtime.cell import ActorCell
+    from ...runtime.fabric import Link
+    from .engine import CRGC
+
+
+class IngressEntryField:
+    """(reference: IngressEntry.java:32-42)"""
+
+    __slots__ = ("message_count", "created_refs")
+
+    def __init__(self) -> None:
+        self.message_count = 0
+        self.created_refs: Dict["ActorCell", int] = {}
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, IngressEntryField)
+            and self.message_count == other.message_count
+            and self.created_refs == other.created_refs
+        )
+
+
+class IngressEntry:
+    """Per-link tally of admitted messages and refs
+    (reference: IngressEntry.java:12-100)."""
+
+    __slots__ = ("id", "admitted", "egress_address", "ingress_address", "is_final")
+
+    def __init__(self) -> None:
+        self.id = 0
+        self.admitted: Dict["ActorCell", IngressEntryField] = {}
+        self.egress_address: Optional[str] = None
+        self.ingress_address: Optional[str] = None
+        self.is_final = False
+
+    def on_message(self, recipient: "ActorCell", refs: Iterable[Any]) -> None:
+        """(reference: IngressEntry.java:91-100)"""
+        field = self.admitted.get(recipient)
+        if field is None:
+            field = IngressEntryField()
+            self.admitted[recipient] = field
+        field.message_count += 1
+        for refob in refs:
+            target = refob.target
+            field.created_refs[target] = field.created_refs.get(target, 0) + 1
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, IngressEntry)
+            and self.id == other.id
+            and self.is_final == other.is_final
+            and self.egress_address == other.egress_address
+            and self.ingress_address == other.ingress_address
+            and self.admitted == other.admitted
+        )
+
+    # Wire format (reference: IngressEntry.java:103-144 field order).
+
+    def serialize(self, encode_cell) -> bytes:
+        import struct
+
+        def pack_str(s):
+            b = (s or "").encode()
+            return struct.pack(">h", len(b)) + b
+
+        parts = [
+            struct.pack(">i?", self.id, self.is_final),
+            pack_str(self.ingress_address),
+            pack_str(self.egress_address),
+            struct.pack(">i", len(self.admitted)),
+        ]
+        for cell, field in self.admitted.items():
+            ref = encode_cell(cell)
+            parts.append(struct.pack(">h", len(ref)))
+            parts.append(ref)
+            parts.append(struct.pack(">ii", field.message_count, len(field.created_refs)))
+            for target, count in field.created_refs.items():
+                tref = encode_cell(target)
+                parts.append(struct.pack(">h", len(tref)))
+                parts.append(tref)
+                parts.append(struct.pack(">i", count))
+        data = b"".join(parts)
+        if events.recorder.enabled:
+            events.recorder.commit(events.INGRESS_ENTRY_SERIALIZATION, size=len(data))
+        return data
+
+    @staticmethod
+    def deserialize(buf: bytes, decode_cell) -> "IngressEntry":
+        import struct
+
+        offset = 0
+
+        def unpack_str():
+            nonlocal offset
+            (n,) = struct.unpack_from(">h", buf, offset)
+            offset += 2
+            s = buf[offset : offset + n].decode()
+            offset += n
+            return s or None
+
+        entry = IngressEntry()
+        entry.id, entry.is_final = struct.unpack_from(">i?", buf, offset)
+        offset += 5
+        entry.ingress_address = unpack_str()
+        entry.egress_address = unpack_str()
+        (n_actors,) = struct.unpack_from(">i", buf, offset)
+        offset += 4
+        for _ in range(n_actors):
+            (rlen,) = struct.unpack_from(">h", buf, offset)
+            offset += 2
+            cell = decode_cell(buf[offset : offset + rlen])
+            offset += rlen
+            field = IngressEntryField()
+            field.message_count, n_refs = struct.unpack_from(">ii", buf, offset)
+            offset += 8
+            for _ in range(n_refs):
+                (tlen,) = struct.unpack_from(">h", buf, offset)
+                offset += 2
+                target = decode_cell(buf[offset : offset + tlen])
+                offset += tlen
+                (count,) = struct.unpack_from(">i", buf, offset)
+                offset += 4
+                field.created_refs[target] = count
+            entry.admitted[cell] = field
+        return entry
+
+
+class Gateway:
+    """(reference: Gateways.scala:25-48)"""
+
+    def __init__(self, egress_address: str, ingress_address: str):
+        self.egress_address = egress_address
+        self.ingress_address = ingress_address
+        self._seqnum = 0
+        self.current_entry = self._create_entry()
+
+    def _create_entry(self) -> IngressEntry:
+        entry = IngressEntry()
+        entry.id = self._seqnum
+        entry.egress_address = self.egress_address
+        entry.ingress_address = self.ingress_address
+        self._seqnum += 1
+        return entry
+
+    def finalize_entry(self) -> IngressEntry:
+        entry = self.current_entry
+        self.current_entry = self._create_entry()
+        return entry
+
+
+class Egress(Gateway):
+    """Sender-side interceptor (reference: Gateways.scala:55-115).
+
+    Only stamps the window id and rolls the window on finalize; the
+    admitted-count tally lives exclusively at the ingress.  (The
+    reference's egress also tallies into its own entry, but that entry's
+    content is discarded at the ingress — Gateways.scala:168-171 uses it
+    purely as a window-boundary marker — so the duplicate per-message
+    bookkeeping is skipped here.)"""
+
+    def __init__(self, link: "Link"):
+        super().__init__(link.src.address, link.dst.address)
+
+    def on_message(self, recipient: "ActorCell", msg: Any) -> None:
+        if isinstance(msg, AppMsg):
+            msg.window_id = self.current_entry.id
+
+
+class Ingress(Gateway):
+    """Receiver-side interceptor; finalized entries go to the local
+    collector (reference: Gateways.scala:121-141)."""
+
+    def __init__(self, link: "Link", engine: "CRGC"):
+        super().__init__(link.src.address, link.dst.address)
+        self.engine = engine
+
+    def on_message(self, recipient: "ActorCell", msg: Any) -> None:
+        if isinstance(msg, AppMsg):
+            self.current_entry.on_message(recipient, msg.refs)
+
+    def finalize_and_send(self, is_final: bool = False) -> None:
+        """(reference: Gateways.scala:131-141)"""
+        from .collector import LocalIngressEntry
+
+        entry = self.finalize_entry()
+        if is_final:
+            entry.is_final = True
+        self.engine.bookkeeper_cell.tell(LocalIngressEntry(entry))

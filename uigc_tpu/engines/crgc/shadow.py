@@ -166,6 +166,40 @@ class ShadowGraph:
             if not refob_info.is_active(info):
                 _update_outgoing(self_shadow.outgoing, target_shadow, -1)
 
+    def merge_delta(self, delta) -> None:
+        """Fold a peer node's compressed batch
+        (reference: ShadowGraph.java:127-156)."""
+        decoder = delta.decoder()
+        for i, delta_shadow in enumerate(delta.shadows):
+            shadow = self.get_shadow(decoder[i])
+            shadow.interned = shadow.interned or delta_shadow.interned
+            shadow.recv_count += delta_shadow.recv_count
+            if delta_shadow.interned:
+                # isBusy/isRoot are only meaningful if the actor produced
+                # an entry in this period (reference: ShadowGraph.java:139-146).
+                shadow.is_busy = delta_shadow.is_busy
+                shadow.is_root = delta_shadow.is_root
+            if delta_shadow.supervisor >= 0:
+                shadow.supervisor = self.get_shadow(decoder[delta_shadow.supervisor])
+            for target_id, count in delta_shadow.outgoing.items():
+                _update_outgoing(
+                    shadow.outgoing, self.get_shadow(decoder[target_id]), count
+                )
+
+    def merge_undo_log(self, log) -> None:
+        """Halt a dead node's actors and revert its unadmitted effects
+        (reference: ShadowGraph.java:158-174)."""
+        for shadow in self.from_set:
+            if shadow.location == log.node_address:
+                shadow.is_halted = True
+            field = log.admitted.get(shadow.self_cell)
+            if field is not None:
+                shadow.recv_count += field.message_count
+                for target_cell, count in field.created_refs.items():
+                    _update_outgoing(
+                        shadow.outgoing, self.get_shadow(target_cell), count
+                    )
+
     # ------------------------------------------------------------- #
     # The trace (reference: ShadowGraph.java:201-289)
     # ------------------------------------------------------------- #

@@ -1,0 +1,90 @@
+"""Undo logs: reverting a dead node's unadmitted effects.
+
+Mirrors the reference's UndoLog (reference: crgc/UndoLog.java:16-105):
+per remote node, subtract everything that node *claimed* to have sent or
+created toward actors it did not host (mergeDeltaGraph), and add back
+what provably crossed each link (mergeIngressEntry).  Once every
+surviving peer's final ingress entry has arrived (the finalization
+quorum, reference: LocalGC.scala:253-257), the net log is folded into the
+shadow graph: the dead node's actors halt and its unadmitted sends/refs
+are reverted (reference: ShadowGraph.java:158-174).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, Dict, Set
+
+from .delta import DeltaGraph
+from .gateways import IngressEntry
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ...runtime.cell import ActorCell
+
+
+class UndoLogField:
+    """(reference: UndoLog.java:21-31)"""
+
+    __slots__ = ("message_count", "created_refs")
+
+    def __init__(self) -> None:
+        self.message_count = 0
+        self.created_refs: Dict["ActorCell", int] = {}
+
+
+class UndoLog:
+    """(reference: UndoLog.java:16-105)"""
+
+    def __init__(self, node_address: str):
+        self.node_address = node_address
+        self.finalized_by: Set[str] = set()
+        self.admitted: Dict["ActorCell", UndoLogField] = {}
+
+    def _field(self, cell: "ActorCell") -> UndoLogField:
+        field = self.admitted.get(cell)
+        if field is None:
+            field = UndoLogField()
+            self.admitted[cell] = field
+        return field
+
+    def merge_delta_graph(self, delta: DeltaGraph) -> None:
+        """Subtract the dead node's claims toward non-interned (remote)
+        actors (reference: UndoLog.java:39-67)."""
+        decoder = delta.decoder()
+        for i, shadow in enumerate(delta.shadows):
+            if shadow.interned:
+                # Only sends/creates toward actors on OTHER nodes matter.
+                continue
+            field = self._field(decoder[i])
+            field.message_count -= shadow.recv_count
+            for target_id, count in shadow.outgoing.items():
+                target = decoder[target_id]
+                self._update(field.created_refs, target, -count)
+
+    def merge_ingress_entry(self, entry: IngressEntry) -> None:
+        """Cancel the admitted portion of the dead node's claims
+        (reference: UndoLog.java:69-93).
+
+        Sign note — deliberate deviation: sends enter the shadow graph
+        NEGATIVELY (recv_count -= send_count) while created refs enter
+        POSITIVELY, so reverting unadmitted claims requires
+        ``message_count = claimed - admitted`` (applied as +) but
+        ``created_refs = admitted - claimed`` (applied as +).  The
+        reference adds admitted message counts (UndoLog.java:81), which
+        would leave every fully-admitted message double-counted in the
+        receive balance after the undo, pinning the recipient as a
+        pseudoroot forever; we subtract instead."""
+        for cell, entry_field in entry.admitted.items():
+            field = self._field(cell)
+            field.message_count -= entry_field.message_count
+            for target, count in entry_field.created_refs.items():
+                self._update(field.created_refs, target, count)
+        if entry.is_final:
+            self.finalized_by.add(entry.ingress_address)
+
+    @staticmethod
+    def _update(outgoing: Dict[Any, int], target: Any, delta: int) -> None:
+        count = outgoing.get(target, 0) + delta
+        if count == 0:
+            outgoing.pop(target, None)
+        else:
+            outgoing[target] = count

@@ -1,0 +1,3 @@
+from .engine import DRL, DrlRefob, DrlState, Token
+
+__all__ = ["DRL", "DrlRefob", "DrlState", "Token"]

@@ -155,3 +155,9 @@ class Engine:
 
     def shutdown(self) -> None:
         """Called on system termination (no reference analogue; ours)."""
+
+    def on_crash(self) -> None:
+        """Called by the fabric when this node is crash-injected: the
+        engine must stop acting immediately (no further collector
+        broadcasts), simulating an abrupt process death."""
+        self.shutdown()

@@ -191,7 +191,14 @@ class ActorCell:
                 break
             processed += 1
             self._needs_block_hook = True
-            self._invoke(msg)
+            try:
+                self._invoke(msg)
+            except Exception:
+                # A failure in an engine hook must not wedge the cell
+                # (leaving _scheduled claimed forever); stop the actor,
+                # like Akka typed's default supervision.
+                traceback.print_exc()
+                self._initiate_stop()
 
         # Mailbox drained while active: fire the finished-processing hook
         # (the forked-Akka ``onFinishedProcessingHook`` analogue) before we

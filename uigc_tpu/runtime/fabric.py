@@ -1,0 +1,182 @@
+"""The multi-node fabric: membership + intercepted, droppable links.
+
+Stands in for the reference's Akka Cluster + Artery remoting layer
+(reference: LocalGC.scala:69-75,198-243 for membership;
+streams/Egress.scala, streams/Ingress.scala, reference.conf:2-10 for the
+per-link interception stages).  Multiple ActorSystems attach to one
+Fabric; application messages between systems flow through per-link
+egress/ingress interceptors supplied by each system's engine, with
+fault-injection hooks (message drops, node crashes) for testing the
+recovery paths — the in-repo multi-node harness the reference lacks
+(SURVEY §4: "Multi-node testing: none in-repo").
+
+Link guarantees mirror a single-lane Artery stream: per-link FIFO
+(GUIDE.md requires one lane so ingress entries see an ordered stream).
+Control-plane traffic between collectors (delta graphs, ingress-entry
+broadcasts) uses ``control_send`` — reliable and not subject to drops,
+like the reference's system-actor messaging.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .cell import ActorCell
+    from .system import ActorSystem
+
+
+class MemberUp:
+    __slots__ = ("address",)
+
+    def __init__(self, address: str):
+        self.address = address
+
+    def __repr__(self) -> str:
+        return f"MemberUp({self.address})"
+
+
+class MemberRemoved:
+    __slots__ = ("address",)
+
+    def __init__(self, address: str):
+        self.address = address
+
+    def __repr__(self) -> str:
+        return f"MemberRemoved({self.address})"
+
+
+class Link:
+    """One directed link between two systems, with its engine-supplied
+    egress (at the sender) and ingress (at the receiver) interceptors."""
+
+    __slots__ = ("src", "dst", "egress", "ingress", "lock", "drop_filter")
+
+    def __init__(self, src: "ActorSystem", dst: "ActorSystem"):
+        self.src = src
+        self.dst = dst
+        # Interceptors (None = pass-through, the default Engine behavior;
+        # reference: Engine.scala:225-276).
+        self.egress = src.engine.spawn_egress(self)
+        self.ingress = dst.engine.spawn_ingress(self)
+        self.lock = threading.Lock()
+        self.drop_filter: Optional[Callable[[Any], bool]] = None
+
+
+class Fabric:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.systems: Dict[str, "ActorSystem"] = {}
+        self.crashed: set = set()
+        self._links: Dict[Tuple[str, str], Link] = {}
+        self._subscribers: List["ActorCell"] = []
+
+    # ------------------------------------------------------------- #
+    # Membership (reference: LocalGC.scala:69-86,198-243)
+    # ------------------------------------------------------------- #
+
+    def register_system(self, system: "ActorSystem") -> None:
+        with self._lock:
+            self.systems[system.address] = system
+            subscribers = list(self._subscribers)
+        for subscriber in subscribers:
+            subscriber.tell(MemberUp(system.address))
+
+    def unregister_system(self, system: "ActorSystem") -> None:
+        self.remove_system(system.address)
+
+    def subscribe(self, cell: "ActorCell") -> None:
+        """Subscribe a (collector) cell to membership events; current
+        members are replayed, like Akka's CurrentClusterState."""
+        with self._lock:
+            self._subscribers.append(cell)
+            current = [a for a in self.systems if a not in self.crashed]
+        for address in current:
+            cell.tell(MemberUp(address))
+
+    def remove_system(self, address: str) -> None:
+        """A node leaves (or crashes): stop delivering to it, notify the
+        survivors (reference: LocalGC.scala:81-83,228-243)."""
+        with self._lock:
+            if address not in self.systems or address in self.crashed:
+                return
+            self.crashed.add(address)
+            subscribers = [
+                s for s in self._subscribers
+                if s.system.address != address
+            ]
+        for subscriber in subscribers:
+            subscriber.tell(MemberRemoved(address))
+
+    def crash(self, system: "ActorSystem") -> None:
+        """Simulate an abrupt node failure (fault injection): the node's
+        engine stops acting immediately, then survivors are notified."""
+        with self._lock:
+            already = system.address in self.crashed
+        if not already:
+            system.engine.on_crash()
+        self.remove_system(system.address)
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return [a for a in self.systems if a not in self.crashed]
+
+    # ------------------------------------------------------------- #
+    # Links and delivery
+    # ------------------------------------------------------------- #
+
+    def link(self, src: "ActorSystem", dst: "ActorSystem") -> Link:
+        key = (src.address, dst.address)
+        with self._lock:
+            link = self._links.get(key)
+            if link is None:
+                link = Link(src, dst)
+                self._links[key] = link
+            return link
+
+    def set_drop_filter(
+        self, src: "ActorSystem", dst: "ActorSystem", fn: Optional[Callable[[Any], bool]]
+    ) -> None:
+        """Inject message drops on a link: fn(msg) -> True to drop."""
+        self.link(src, dst).drop_filter = fn
+
+    def deliver(
+        self, src: "ActorSystem", target: "ActorCell", msg: Any
+    ) -> None:
+        """Send an application message across a link: egress interception,
+        optional drop, ingress interception, then local delivery
+        (reference: Gateways.scala:72-115,153-191)."""
+        dst = target.system
+        if src.address in self.crashed:
+            return
+        link = self.link(src, dst)
+        with link.lock:
+            if link.egress is not None:
+                link.egress.on_message(target, msg)
+            dropped = link.drop_filter is not None and link.drop_filter(msg)
+            if dropped or dst.address in self.crashed:
+                return
+            if link.ingress is not None:
+                link.ingress.on_message(target, msg)
+        target.tell(msg)
+
+    def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
+        """Ask the egress of link (src -> dst) to finalize its entry and
+        push the boundary marker to the ingress, which finalizes the
+        matching admitted-entry and hands it to the destination collector
+        (reference: Gateways.scala:87-94,168-171)."""
+        with self._lock:
+            dst = self.systems.get(dst_address)
+        if dst is None or dst_address in self.crashed or src.address in self.crashed:
+            return
+        link = self.link(src, dst)
+        with link.lock:
+            if link.egress is not None and link.ingress is not None:
+                link.egress.finalize_entry()
+                # Marker traverses the (FIFO, in-process) link immediately.
+                link.ingress.finalize_and_send()
+
+    def ingress_links_to(self, dst: "ActorSystem") -> List[Link]:
+        with self._lock:
+            return [l for (s, d), l in self._links.items() if d == dst.address]
