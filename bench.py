@@ -23,6 +23,12 @@ def main() -> None:
     parser.add_argument("--reps", type=int, default=20)
     parser.add_argument("--garbage-fraction", type=float, default=0.5)
     parser.add_argument("--small", action="store_true", help="quick CPU-sized run")
+    parser.add_argument(
+        "--impl",
+        choices=["pallas", "xla"],
+        default=None,
+        help="trace implementation (default: pallas on TPU, xla elsewhere)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -41,15 +47,37 @@ def main() -> None:
     from uigc_tpu.models import powerlaw_actor_graph
     from uigc_tpu.ops import trace as trace_ops
 
+    impl = args.impl or ("pallas" if platform == "tpu" else "xla")
+
     graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=args.garbage_fraction)
 
-    if "fn" not in trace_ops._jax_trace_cache:
-        trace_ops._jax_trace_cache["fn"] = trace_ops._build_jax_trace()
-    fn = trace_ops._jax_trace_cache["fn"]
+    if impl == "pallas":
+        from uigc_tpu.ops import pallas_trace
 
-    dev_args = [
-        jax.device_put(x)
-        for x in (
+        prep = pallas_trace.prepare_chunks(
+            graph["edge_src"].astype(np.int32),
+            graph["edge_dst"].astype(np.int32),
+            graph["edge_weight"],
+            graph["supervisor"],
+            n,
+        )
+        fn = pallas_trace.get_trace_fn(prep)
+        host_args = (
+            graph["flags"],
+            graph["recv_count"],
+            prep["super"],
+            prep["first"],
+            prep["row_pos"],
+            prep["lane_idx"],
+            prep["bit_pos"],
+            prep["dst_sub"],
+            prep["dst_lane"],
+        )
+    else:
+        if "fn" not in trace_ops._jax_trace_cache:
+            trace_ops._jax_trace_cache["fn"] = trace_ops._build_jax_trace()
+        fn = trace_ops._jax_trace_cache["fn"]
+        host_args = (
             graph["flags"],
             graph["recv_count"],
             graph["supervisor"],
@@ -57,7 +85,8 @@ def main() -> None:
             graph["edge_dst"].astype(np.int32),
             graph["edge_weight"],
         )
-    ]
+
+    dev_args = [jax.device_put(x) for x in host_args]
 
     # Warmup / compile, and verify verdicts.
     mark = fn(*dev_args)
@@ -76,19 +105,16 @@ def main() -> None:
     reps = args.reps
 
     @jax.jit
-    def chained(flags, recv, sup, esrc, edst, ew):
+    def chained(*state0):
         def body(_, carry):
             acc, state = carry
-            flags, recv, sup, esrc, edst, ew = state
-            mark = fn(flags, recv, sup, esrc, edst, ew)
+            mark = fn(*state)
             # Real data dependency so no trace can be elided or fused
             # away across iterations.
             acc = acc + jnp.count_nonzero(mark)
             state = jax.lax.optimization_barrier(state)
             return acc, state
-        acc, _ = jax.lax.fori_loop(
-            0, reps, body, (0, (flags, recv, sup, esrc, edst, ew))
-        )
+        acc, _ = jax.lax.fori_loop(0, reps, body, (0, state0))
         return acc
 
     int(chained(*dev_args))  # compile
@@ -117,6 +143,7 @@ def main() -> None:
         "n_garbage": n_garbage,
         "n_edges": int(graph["edge_src"].shape[0]),
         "platform": platform,
+        "impl": impl,
     }
     print(json.dumps(result))
 

@@ -1,0 +1,380 @@
+"""Pallas TPU kernel for the liveness-trace propagation step.
+
+The trace (ops/trace.py) is an iterative frontier expansion whose inner op
+is, per propagation pair (src, dst): OR the source's active bit into the
+destination's mark.  XLA lowers both the gather of source bits and the
+scatter into destinations to serialized per-element loops (~7 ns/edge
+measured) — the bottleneck at graph scale.  This kernel vectorizes both
+sides with the primitives the TPU VPU/MXU actually has:
+
+**Gather side.**  The active bit-vector is packed into a 32-bit word table
+``T[R, 128]`` that stays VMEM-resident across the whole sweep (128 KB per
+1M actors).  Mosaic supports per-vreg dynamic shuffles
+(``take_along_axis`` within an (8, 128) register: axis=1 lane-gather and
+axis=0 sublane-gather) but nothing across vregs, so the kernel loops over
+8-row table chunks with a two-step shuffle:
+
+    g1[i, j] = chunk[i, lane_idx[i, j]]        (lane-gather)
+    g2[i, j] = g1[row_sel[i, j], j]            (sublane-gather)
+    word     = select(chunk hit, g2)
+
+which yields, for the edge parked at slot (i, j), the word at
+``(row_e, lane_e)`` provided the host placed it so that
+``lane_idx[row_e % 8, j] == lane_e``.  The host-side packer (prepare_chunks)
+bins each destination supertile's edges into columns with at most one edge
+per (row_e mod 8) class per column, which makes that binding conflict-free
+by construction; slots left empty get an out-of-range row so they read 0.
+
+**Scatter side.**  Edges are pre-sorted by destination supertile (1024
+nodes = one (8, 128) f32 output block).  Each block-row of 128 edge values
+becomes a segment-sum via two in-register one-hot factors contracted on
+the MXU:
+
+    A_r[s, c] = vals[r, c] * (dst_sub[r, c] == s)       (8, 128)
+    B_r[c, l] = (dst_lane[r, c] == l)                   (128, 128)
+    contrib  += A_r @ B_r                               (8, 128)
+
+The output BlockSpec revisits one supertile block per run of grid steps
+via a scalar-prefetched supertile-id array, so accumulation happens in
+VMEM and each block hits HBM exactly once per sweep.  Empty supertiles get
+a dummy all-padding group so every output block is initialized.
+
+Semantics are identical to ``trace_marks_np`` (the oracle for the
+reference's ShadowGraph.java:205-289): supervisor pointers are folded in
+as ordinary propagation pairs, sources gate on ``mark & ~halted``, and
+only positive-weight edges propagate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from . import trace as trace_ops
+
+LANE = 128  # lanes per vreg row
+ROWS = 8  # sublane rows per block
+SUPER = ROWS * LANE  # destination nodes per output block / edges per group
+WORD_BITS = 32
+# Sentinel row for empty slots: beyond any table chunk, so they read 0.
+_PAD_ROW = np.int32(1 << 28)
+
+
+def prepare_chunks(
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_weight: np.ndarray,
+    supervisor: np.ndarray,
+    n: int,
+) -> Dict[str, np.ndarray]:
+    """Host-side packer: place propagation pairs into kernel blocks.
+
+    Rebuild whenever the edge set or supervisor pointers change (one
+    lexsort of the live pairs, amortized across the trace's fixpoint
+    iterations and across traces between graph mutations).
+    """
+    live = edge_weight > 0
+    psrc = edge_src[live].astype(np.int64)
+    pdst = edge_dst[live].astype(np.int64)
+    sup_src = np.nonzero(supervisor >= 0)[0].astype(np.int64)
+    if sup_src.size:
+        psrc = np.concatenate([psrc, sup_src])
+        pdst = np.concatenate([pdst, supervisor[sup_src].astype(np.int64)])
+
+    n_super = max(1, -(-n // SUPER))
+    n_pad = n_super * SUPER
+    # Bit table geometry: R rows of 128 lanes of 32-bit words.
+    n_words = -(-n_pad // WORD_BITS)
+    r_rows = -(-n_words // LANE)
+    r_rows = ((r_rows + ROWS - 1) // ROWS) * ROWS  # multiple of 8
+
+    m = psrc.size
+    word = psrc >> 5
+    w_row = (word >> 7).astype(np.int32)
+    w_lane = (word & 127).astype(np.int32)
+    w_bit = (psrc & 31).astype(np.int32)
+    d_super = (pdst // SUPER).astype(np.int64)
+    d_local = (pdst % SUPER).astype(np.int64)
+    r8 = (w_row & 7).astype(np.int64)
+
+    # --- placement -----------------------------------------------------
+    # Sort by (dst supertile, row%8 class); rank within each class gives
+    # a (block-in-supertile, column) slot such that each column holds at
+    # most one edge per class — the lane-binding is then conflict-free.
+    order = np.lexsort((r8, d_super))
+    psrc, w_row, w_lane, w_bit = (
+        psrc[order],
+        w_row[order],
+        w_lane[order],
+        w_bit[order],
+    )
+    d_super, d_local, r8 = d_super[order], d_local[order], r8[order]
+
+    # rank of each edge within its (d_super, r8) class
+    if m:
+        key_change = np.ones(m, dtype=bool)
+        key_change[1:] = (d_super[1:] != d_super[:-1]) | (r8[1:] != r8[:-1])
+        start_idx = np.nonzero(key_change)[0]
+        starts = np.repeat(start_idx, np.diff(np.append(start_idx, m)))
+        rank = np.arange(m, dtype=np.int64) - starts
+    else:
+        rank = np.zeros(0, dtype=np.int64)
+
+    # blocks needed per supertile = max over classes of ceil(class/128)
+    blocks_needed = np.zeros(n_super, dtype=np.int64)
+    if m:
+        per_class_blocks = rank // LANE + 1
+        np.maximum.at(
+            blocks_needed, d_super, per_class_blocks
+        )
+    blocks_needed = np.maximum(blocks_needed, 1)  # dummy for empty supertiles
+
+    n_blocks = int(blocks_needed.sum())
+    block_base = np.zeros(n_super, dtype=np.int64)
+    block_base[1:] = np.cumsum(blocks_needed)[:-1]
+
+    if m:
+        g_block = block_base[d_super] + rank // LANE
+        col = rank % LANE
+        # slot within (block, col): edges there have distinct r8; order by
+        # r8 via a second pass
+        slot_key = g_block * LANE + col
+        order2 = np.lexsort((r8, slot_key))
+        inv = np.empty(m, dtype=np.int64)
+        sk_sorted = slot_key[order2]
+        change2 = np.ones(m, dtype=bool)
+        change2[1:] = sk_sorted[1:] != sk_sorted[:-1]
+        start2 = np.nonzero(change2)[0]
+        starts2 = np.repeat(start2, np.diff(np.append(start2, m)))
+        slot_sorted = np.arange(m, dtype=np.int64) - starts2
+        inv[order2] = slot_sorted
+        slot = inv  # per-edge sublane slot in its (block, col)
+    else:
+        g_block = np.zeros(0, dtype=np.int64)
+        col = np.zeros(0, dtype=np.int64)
+        slot = np.zeros(0, dtype=np.int64)
+
+    assert not m or slot.max() < ROWS, "placement overflow: >8 classes per column"
+
+    # --- fill kernel arrays -------------------------------------------
+    shape = (n_blocks * ROWS, LANE)
+    row_pos = np.full(shape, _PAD_ROW, dtype=np.int32)
+    lane_idx = np.zeros(shape, dtype=np.int32)
+    bit_pos = np.zeros(shape, dtype=np.int32)
+    dst_sub = np.zeros(shape, dtype=np.int32)
+    dst_lane = np.zeros(shape, dtype=np.int32)
+
+    if m:
+        ri = g_block * ROWS + slot
+        row_pos[ri, col] = w_row
+        bit_pos[ri, col] = w_bit
+        dst_sub[ri, col] = (d_local >> 7).astype(np.int32)
+        dst_lane[ri, col] = (d_local & 127).astype(np.int32)
+        # lane binding: consulted at (row_e % 8, col)
+        li = g_block * ROWS + r8
+        lane_idx[li, col] = w_lane
+
+    block_super = np.repeat(
+        np.arange(n_super, dtype=np.int32), blocks_needed
+    )
+    block_first = np.zeros(n_blocks, dtype=np.int32)
+    block_first[block_base] = 1
+
+    return {
+        "row_pos": row_pos,
+        "lane_idx": lane_idx,
+        "bit_pos": bit_pos,
+        "dst_sub": dst_sub,
+        "dst_lane": dst_lane,
+        "super": block_super,
+        "first": block_first,
+        "n_super": n_super,
+        "n_blocks": n_blocks,
+        "r_rows": r_rows,
+        "n_pad": n_pad,
+        "n": n,
+    }
+
+
+_fn_cache: Dict[tuple, object] = {}
+
+
+def _build_trace_fn(
+    n: int, n_blocks: int, n_super: int, r_rows: int, interpret: bool
+):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F = trace_ops
+    n_chunks = r_rows // ROWS
+
+    def kernel(
+        sup_ref,
+        first_ref,
+        table_ref,
+        row_ref,
+        laneidx_ref,
+        bit_ref,
+        dsub_ref,
+        dlane_ref,
+        out_ref,
+    ):
+        i = pl.program_id(0)
+        row_pos = row_ref[:]
+        lane_idx = laneidx_ref[:]
+
+        def chunk_body(c, acc):
+            tab_c = table_ref[pl.ds(c * ROWS, ROWS), :]
+            g1 = jnp.take_along_axis(tab_c, lane_idx, axis=1)
+            row_rel = row_pos - c * ROWS
+            row_sel = jnp.clip(row_rel, 0, ROWS - 1)
+            g2 = jnp.take_along_axis(g1, row_sel, axis=0)
+            hit = (row_rel >= 0) & (row_rel < ROWS)
+            return jnp.where(hit, g2, acc)
+
+        words = jax.lax.fori_loop(
+            0, n_chunks, chunk_body, jnp.zeros((ROWS, LANE), jnp.int32)
+        )
+        bits = jax.lax.shift_right_logical(words, bit_ref[:]) & 1
+        vals = bits.astype(jnp.float32)
+
+        sub_iota = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANE), 0)
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
+        acc = jnp.zeros((ROWS, LANE), jnp.float32)
+        for r in range(ROWS):
+            vals_r = vals[r, :]
+            a = jnp.where(sub_iota == dsub_ref[r, :][None, :], vals_r[None, :], 0.0)
+            b = jnp.where(lane_iota == dlane_ref[r, :][:, None], 1.0, 0.0)
+            acc = acc + jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+        @pl.when(first_ref[i] == 1)
+        def _():
+            out_ref[:] = acc
+
+        @pl.when(first_ref[i] == 0)
+        def _():
+            out_ref[:] = out_ref[:] + acc
+
+    blockmap = pl.BlockSpec((ROWS, LANE), lambda i, sup, first: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_blocks,),
+        in_specs=[
+            # bit table: whole array, VMEM-resident across all steps
+            pl.BlockSpec((r_rows, LANE), lambda i, sup, first: (0, 0)),
+            blockmap,  # row_pos
+            blockmap,  # lane_idx
+            blockmap,  # bit_pos
+            blockmap,  # dst_sub
+            blockmap,  # dst_lane
+        ],
+        out_specs=pl.BlockSpec((ROWS, LANE), lambda i, sup, first: (sup[i], 0)),
+    )
+    propagate = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_super * ROWS, LANE), jnp.float32),
+        interpret=interpret,
+    )
+
+    n_pad = n_super * SUPER
+    n_words_pad = r_rows * LANE
+
+    def trace_fn(
+        flags, recv_count, block_super, block_first, row_pos, lane_idx,
+        bit_pos, dst_sub, dst_lane,
+    ):
+        in_use = (flags & F.FLAG_IN_USE) != 0
+        halted = (flags & F.FLAG_HALTED) != 0
+        seed = (
+            ((flags & F.FLAG_ROOT) != 0)
+            | ((flags & F.FLAG_BUSY) != 0)
+            | (recv_count != 0)
+            | ((flags & F.FLAG_INTERNED) == 0)
+        )
+        mark0 = in_use & (~halted) & seed
+
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)
+
+        def pack(active):
+            a = jnp.zeros(n_words_pad * WORD_BITS, jnp.int32)
+            a = a.at[:n].set(active.astype(jnp.int32))
+            w = (a.reshape(-1, WORD_BITS) << shifts[None, :]).sum(
+                axis=1, dtype=jnp.int32
+            )
+            return w.reshape(r_rows, LANE)
+
+        def cond(carry):
+            _, changed = carry
+            return changed
+
+        def body(carry):
+            mark, _ = carry
+            table = pack(mark & (~halted))
+            contrib = propagate(
+                block_super, block_first, table, row_pos, lane_idx,
+                bit_pos, dst_sub, dst_lane,
+            )
+            hits = contrib.reshape(-1)[:n] > 0
+            new_mark = mark | (hits & in_use)
+            changed = jnp.any(new_mark != mark)
+            return new_mark, changed
+
+        mark, _ = jax.lax.while_loop(cond, body, (mark0, jnp.array(True)))
+        return mark
+
+    return jax.jit(trace_fn)
+
+
+def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
+    """Cached jitted trace fn for a prepared pair-array layout.
+
+    ``interpret`` defaults to True off-TPU (Mosaic can't compile there)."""
+    import jax
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    key = (prep["n"], prep["n_blocks"], prep["n_super"], prep["r_rows"], interpret)
+    fn = _fn_cache.get(key)
+    if fn is None:
+        fn = _build_trace_fn(
+            prep["n"], prep["n_blocks"], prep["n_super"], prep["r_rows"], interpret
+        )
+        _fn_cache[key] = fn
+    return fn
+
+
+def trace_marks_prepared(flags, recv_count, prep: Dict[str, np.ndarray]) -> np.ndarray:
+    """Run the Pallas-backed trace against pre-packed pair arrays."""
+    n = prep["n"]
+    fn = get_trace_fn(prep)
+    out = fn(
+        flags[:n],
+        recv_count[:n],
+        prep["super"],
+        prep["first"],
+        prep["row_pos"],
+        prep["lane_idx"],
+        prep["bit_pos"],
+        prep["dst_sub"],
+        prep["dst_lane"],
+    )
+    return np.asarray(out)
+
+
+def trace_marks_pallas(
+    flags, recv_count, supervisor, edge_src, edge_dst, edge_weight
+) -> np.ndarray:
+    """Same contract as trace_marks_np/_jax, Pallas propagation inside."""
+    n = flags.shape[0]
+    prep = prepare_chunks(
+        np.asarray(edge_src),
+        np.asarray(edge_dst),
+        np.asarray(edge_weight),
+        np.asarray(supervisor),
+        n,
+    )
+    return trace_marks_prepared(np.asarray(flags), np.asarray(recv_count), prep)
