@@ -67,7 +67,17 @@ class Root(AbstractBehavior):
         return self
 
 
-@pytest.mark.parametrize("backend", ["oracle", "array", "device"])
+from uigc_tpu import native as _native
+
+NATIVE = pytest.param(
+    "native",
+    marks=pytest.mark.skipif(
+        not _native.is_available(), reason="no C++ toolchain"
+    ),
+)
+
+
+@pytest.mark.parametrize("backend", ["oracle", "array", "device", NATIVE])
 def test_cycle_collection_all_backends(backend):
     kit = ActorTestKit(
         {"uigc.crgc.wakeup-interval": 10, "uigc.crgc.shadow-graph": backend}

@@ -126,8 +126,18 @@ class SimActor:
         self.flush()
 
 
+def graph_cells(graph):
+    """The set of actors currently interned in a graph, regardless of
+    backend (oracle/array/native)."""
+    if hasattr(graph, "shadow_map"):
+        return set(graph.shadow_map.keys())
+    if hasattr(graph, "slot_of"):
+        return set(graph.slot_of.keys())
+    return set(graph._id_of_cell.keys())
+
+
 class Sim:
-    def __init__(self, seed, use_device=False):
+    def __init__(self, seed, backend="array"):
         self.rng = random.Random(seed)
         self.system = FakeSystem()
         self.context = CrgcContext(delta_graph_size=64, entry_field_size=4)
@@ -135,9 +145,14 @@ class Sim:
         self.actors = {}
         self.children = {}
         self.oracle = ShadowGraph(self.context, self.system.address)
-        self.array = ArrayShadowGraph(
-            self.context, self.system.address, use_device=use_device
-        )
+        if backend == "native":
+            from uigc_tpu.native import NativeShadowGraph
+
+            self.array = NativeShadowGraph(self.context, self.system.address)
+        else:
+            self.array = ArrayShadowGraph(
+                self.context, self.system.address, use_device=(backend == "device")
+            )
         root_cell = FakeCell(self.system)
         self.root = SimActor(self, root_cell, None, self.context)
         self.actors[root_cell] = self.root
@@ -187,14 +202,14 @@ class Sim:
         self.entries = []
 
         before_oracle = set(self.oracle.shadow_map.keys())
-        before_array = set(self.array.slot_of.keys())
+        before_array = graph_cells(self.array)
         assert before_oracle == before_array
 
         self.oracle.trace(should_kill=False)
         self.array.trace(should_kill=False)
 
         after_oracle = set(self.oracle.shadow_map.keys())
-        after_array = set(self.array.slot_of.keys())
+        after_array = graph_cells(self.array)
         garbage_oracle = before_oracle - after_oracle
         garbage_array = before_array - after_array
         assert garbage_oracle == garbage_array, (
@@ -231,10 +246,20 @@ class Sim:
         return garbage_oracle
 
 
-@pytest.mark.parametrize("use_device", [False, True], ids=["array", "device"])
+from uigc_tpu import native as _native
+
+NATIVE = pytest.param(
+    "native",
+    marks=pytest.mark.skipif(
+        not _native.is_available(), reason="no C++ toolchain"
+    ),
+)
+
+
+@pytest.mark.parametrize("backend", ["array", "device", NATIVE])
 @pytest.mark.parametrize("seed", [7, 42, 20260729])
-def test_random_protocol_parity(seed, use_device):
-    sim = Sim(seed, use_device=use_device)
+def test_random_protocol_parity(seed, backend):
+    sim = Sim(seed, backend=backend)
     for round_no in range(20):
         for _ in range(150):
             sim.random_step()
@@ -264,8 +289,9 @@ def test_random_protocol_parity(seed, use_device):
 def test_supervisor_marking_parity():
     """A live child must keep its (otherwise-garbage) parent alive in both
     implementations (reference: ShadowGraph.java:242-267)."""
-    for use_device in (False, True):
-        sim = Sim(1, use_device=use_device)
+    backends = ["array", "device"] + (["native"] if _native.is_available() else [])
+    for backend in backends:
+        sim = Sim(1, backend=backend)
         parent = sim.root.spawn()
         parent_ref = sim.root.acquaintances[0]
         child = parent.spawn()

@@ -38,6 +38,7 @@ DEFAULTS: Dict[str, Any] = {
     #   "oracle" - pointer-based graph mirroring the JVM semantics exactly
     #   "array"  - dense-array graph folded on host (numpy)
     #   "device" - dense-array graph with the trace run on the TPU via JAX
+    #   "native" - C++ data plane (uigc_tpu/native/), batch fold + trace
     "uigc.crgc.shadow-graph": "array",
     # --- MAC engine settings (reference: reference.conf:43-50) ---
     "uigc.mac.cycle-detection": False,

@@ -85,6 +85,10 @@ class CRGC(Engine):
                 self.system.address,
                 use_device=(self.shadow_graph_impl == "device"),
             )
+        elif self.shadow_graph_impl == "native":
+            from ...native import NativeShadowGraph
+
+            return NativeShadowGraph(self.crgc_context, self.system.address)
         raise ValueError(f"bad shadow-graph impl {self.shadow_graph_impl!r}")
 
     # ----------------------------------------------------------------- #
