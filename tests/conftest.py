@@ -18,3 +18,18 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+from uigc_tpu import native as _native  # noqa: E402
+
+#: True when the C++ data plane could be built and loaded.
+NATIVE_AVAILABLE = _native.is_available()
+
+#: Shared parametrize value for the native shadow-graph backend: skips
+#: (visibly) instead of silently dropping coverage when g++ is missing.
+NATIVE_BACKEND = pytest.param(
+    "native",
+    marks=pytest.mark.skipif(not NATIVE_AVAILABLE, reason="no C++ toolchain"),
+)

@@ -67,17 +67,10 @@ class Root(AbstractBehavior):
         return self
 
 
-from uigc_tpu import native as _native
-
-NATIVE = pytest.param(
-    "native",
-    marks=pytest.mark.skipif(
-        not _native.is_available(), reason="no C++ toolchain"
-    ),
-)
+from conftest import NATIVE_BACKEND
 
 
-@pytest.mark.parametrize("backend", ["oracle", "array", "device", NATIVE])
+@pytest.mark.parametrize("backend", ["oracle", "array", "device", NATIVE_BACKEND])
 def test_cycle_collection_all_backends(backend):
     kit = ActorTestKit(
         {"uigc.crgc.wakeup-interval": 10, "uigc.crgc.shadow-graph": backend}

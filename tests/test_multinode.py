@@ -23,9 +23,15 @@ BASE = {
 }
 
 
-def make_system(name, fabric, num_nodes):
+from conftest import NATIVE_BACKEND
+
+BACKENDS = ["array", NATIVE_BACKEND]
+
+
+def make_system(name, fabric, num_nodes, backend="array"):
     config = dict(BASE)
     config["uigc.crgc.num-nodes"] = num_nodes
+    config["uigc.crgc.shadow-graph"] = backend
     return ActorSystem(None, name=name, config=config, fabric=fabric)
 
 
@@ -101,10 +107,11 @@ class Root(AbstractBehavior):
         return self
 
 
-def test_two_node_remote_spawn_and_collect():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_two_node_remote_spawn_and_collect(backend):
     fabric = Fabric()
-    sys_a = make_system("nodeA", fabric, 2)
-    sys_b = make_system("nodeB", fabric, 2)
+    sys_a = make_system("nodeA", fabric, 2, backend)
+    sys_b = make_system("nodeB", fabric, 2, backend)
     try:
         probe = Probe(default_timeout_s=15.0)
         spawner = RemoteSpawner.spawn_service(
@@ -162,16 +169,17 @@ class Owner(AbstractBehavior):
         return self
 
 
+@pytest.mark.parametrize("backend", BACKENDS)
 @pytest.mark.parametrize("with_drops", [False, True], ids=["clean", "drops"])
-def test_three_node_crash_recovery(with_drops):
+def test_three_node_crash_recovery(with_drops, backend):
     """A worker on B is kept alive solely by a ref held on C.  C crashes;
     the undo-log quorum reverts C's claims and the worker is collected.
     With drops injected on the C->B link, admitted counts diverge from
     claims — exactly what the ingress-entry machinery reconciles."""
     fabric = Fabric()
-    sys_a = make_system("cnodeA", fabric, 3)
-    sys_b = make_system("cnodeB", fabric, 3)
-    sys_c = make_system("cnodeC", fabric, 3)
+    sys_a = make_system("cnodeA", fabric, 3, backend)
+    sys_b = make_system("cnodeB", fabric, 3, backend)
+    sys_c = make_system("cnodeC", fabric, 3, backend)
     try:
         probe = Probe(default_timeout_s=20.0)
 
@@ -212,15 +220,16 @@ def test_three_node_crash_recovery(with_drops):
         sys_c.terminate()
 
 
-def test_double_crash_quorum_recheck():
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_double_crash_quorum_recheck(backend):
     """If a second node dies before delivering its final ingress entry
     for the first dead node, the shrunken quorum must be re-evaluated on
     membership change — otherwise the first node's undo log never folds
     and its actors leak as eternal pseudoroots."""
     fabric = Fabric()
-    sys_a = make_system("dcA", fabric, 3)
-    sys_b = make_system("dcB", fabric, 3)
-    sys_c = make_system("dcC", fabric, 3)
+    sys_a = make_system("dcA", fabric, 3, backend)
+    sys_b = make_system("dcB", fabric, 3, backend)
+    sys_c = make_system("dcC", fabric, 3, backend)
     try:
         probe = Probe(default_timeout_s=20.0)
         holder = sys_c.spawn_root(

@@ -246,17 +246,10 @@ class Sim:
         return garbage_oracle
 
 
-from uigc_tpu import native as _native
-
-NATIVE = pytest.param(
-    "native",
-    marks=pytest.mark.skipif(
-        not _native.is_available(), reason="no C++ toolchain"
-    ),
-)
+from conftest import NATIVE_AVAILABLE, NATIVE_BACKEND
 
 
-@pytest.mark.parametrize("backend", ["array", "device", NATIVE])
+@pytest.mark.parametrize("backend", ["array", "device", NATIVE_BACKEND])
 @pytest.mark.parametrize("seed", [7, 42, 20260729])
 def test_random_protocol_parity(seed, backend):
     sim = Sim(seed, backend=backend)
@@ -289,7 +282,7 @@ def test_random_protocol_parity(seed, backend):
 def test_supervisor_marking_parity():
     """A live child must keep its (otherwise-garbage) parent alive in both
     implementations (reference: ShadowGraph.java:242-267)."""
-    backends = ["array", "device"] + (["native"] if _native.is_available() else [])
+    backends = ["array", "device"] + (["native"] if NATIVE_AVAILABLE else [])
     for backend in backends:
         sim = Sim(1, backend=backend)
         parent = sim.root.spawn()

@@ -35,7 +35,11 @@ def _build() -> None:
     tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"g++ failed (exit {proc.returncode}): {proc.stderr.strip()}"
+            )
         os.replace(tmp, _LIB)
     finally:
         if os.path.exists(tmp):
@@ -75,6 +79,8 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.uigc_trace.argtypes = [ctypes.c_void_p, _p_i64, _p_i64, _p_i64, _p_i64]
     lib.uigc_local_roots.restype = _i64
     lib.uigc_local_roots.argtypes = [ctypes.c_void_p, _p_i64]
+    lib.uigc_live_ids.restype = _i64
+    lib.uigc_live_ids.argtypes = [ctypes.c_void_p, _p_i64]
     lib.uigc_count_reachable_from.restype = _i64
     lib.uigc_count_reachable_from.argtypes = [ctypes.c_void_p, _i64]
 

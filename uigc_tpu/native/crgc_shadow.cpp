@@ -318,6 +318,16 @@ int64_t uigc_local_roots(void* gp, int64_t* out_ids) {
   return n;
 }
 
+// Every interned actor id.  Buffer must hold uigc_num_in_use() entries.
+// Lets the Python wrapper reconcile its id<->cell maps after folds that
+// mention actors the graph never interns (undo logs).
+int64_t uigc_live_ids(void* gp, int64_t* out_ids) {
+  Graph& g = *static_cast<Graph*>(gp);
+  int64_t n = 0;
+  for (const auto& kv : g.slot_of_id) out_ids[n++] = kv.first;
+  return n;
+}
+
 // Actors reachable from any actor located at node_id
 // (reference: ShadowGraph.java:302-330).
 int64_t uigc_count_reachable_from(void* gp, int64_t node_id) {

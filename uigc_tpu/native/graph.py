@@ -226,6 +226,19 @@ class NativeShadowGraph:
             _p64(np.array(created_targets, dtype=_I64)),
             _p64(np.array(created_counts, dtype=_I64)),
         )
+        # The undo fold only interns actors already in the graph or reached
+        # through a visited field; admitted cells the graph never saw must
+        # not linger in the id maps (they would never be swept).
+        self._prune_id_maps()
+
+    def _prune_id_maps(self) -> None:
+        cap = int(self._lib.uigc_num_in_use(self._handle))
+        live = np.empty(max(cap, 1), dtype=_I64)
+        n = int(self._lib.uigc_live_ids(self._handle, _p64(live)))
+        keep = set(int(aid) for aid in live[:n])
+        for aid in [a for a in self._cell_of_id if a not in keep]:
+            cell = self._cell_of_id.pop(aid)
+            self._id_of_cell.pop(cell, None)
 
     # ------------------------------------------------------------- #
     # Trace + sweep (reference: ShadowGraph.java:205-289)
