@@ -9,35 +9,45 @@ sides with the primitives the TPU VPU/MXU actually has:
 
 **Gather side.**  The active bit-vector is packed into a 32-bit word table
 ``T[R, 128]`` that stays VMEM-resident across the whole sweep (128 KB per
-1M actors).  Mosaic supports per-vreg dynamic shuffles
-(``take_along_axis`` within an (8, 128) register: axis=1 lane-gather and
-axis=0 sublane-gather) but nothing across vregs, so the kernel loops over
-8-row table chunks with a two-step shuffle:
+1M actors).  Mosaic supports per-vreg dynamic lane shuffles
+(``take_along_axis`` within an (8, 128) register) but nothing across
+vregs, so each grid step walks 8-row table chunks.  Two layout invariants
+make the walk cheap:
 
-    g1[i, j] = chunk[i, lane_idx[i, j]]        (lane-gather)
-    g2[i, j] = g1[row_sel[i, j], j]            (sublane-gather)
-    word     = select(chunk hit, g2)
+1. *Slot row = source row mod 8.*  An edge whose source bit lives at table
+   position (row_e, lane_e) is parked at slot ``(row_e % 8, col)``, so
+   when the walk reaches the edge's chunk a single lane-gather
+   ``take_along_axis(chunk, lane, axis=1)`` lands the right word at the
+   edge's own slot — no cross-sublane shuffle, no slot/lane binding table.
+   Uniqueness (one edge per (chunk-row-class, col) pair) is guaranteed by
+   the host packer, which ranks edges within each (dst supertile,
+   row-class) group and assigns col = rank mod 128.
 
-which yields, for the edge parked at slot (i, j), the word at
-``(row_e, lane_e)`` provided the host placed it so that
-``lane_idx[row_e % 8, j] == lane_e``.  The host-side packer (prepare_chunks)
-bins each destination supertile's edges into columns with at most one edge
-per (row_e mod 8) class per column, which makes that binding conflict-free
-by construction; slots left empty get an out-of-range row so they read 0.
+2. *Per-block chunk ranges.*  Within each (dst supertile, row-class)
+   group the packer sorts edges by source row, so the 128-edge runs that
+   land in one block cover a narrow, contiguous band of the table.  The
+   block's ``[c_lo, c_lo + span)`` range is scalar-prefetched and the
+   kernel's chunk loop walks only that band — total chunk-iterations per
+   sweep are O(n_super · n_chunks + n_blocks), not O(n_blocks · n_chunks),
+   which is what lets the kernel scale to 10M+ actors.
 
-**Scatter side.**  Edges are pre-sorted by destination supertile (1024
-nodes = one (8, 128) f32 output block).  Each block-row of 128 edge values
-becomes a segment-sum via two in-register one-hot factors contracted on
-the MXU:
+**Scatter side.**  Edges are pre-sorted by destination supertile
+(``SUPER = S_ROWS * 128`` nodes = one (S_ROWS, 128) f32 output block).
+The block's 8x128 gathered bits become a segment-sum via one fused one-hot
+contraction on the MXU:
 
-    A_r[s, c] = vals[r, c] * (dst_sub[r, c] == s)       (8, 128)
-    B_r[c, l] = (dst_lane[r, c] == l)                   (128, 128)
-    contrib  += A_r @ B_r                               (8, 128)
+    A[s, r*128+c] = vals[r, c] * (dst_sub[r, c] == s)     (S_ROWS, 1024)
+    B[r*128+c, l] = (dst_lane[r, c] == l)                 (1024, 128)
+    contrib      += A @ B                                 (S_ROWS, 128)
 
-The output BlockSpec revisits one supertile block per run of grid steps
-via a scalar-prefetched supertile-id array, so accumulation happens in
-VMEM and each block hits HBM exactly once per sweep.  Empty supertiles get
-a dummy all-padding group so every output block is initialized.
+A and B are 0/1 so bf16 inputs with f32 accumulation are exact, doubling
+MXU rate.  The output BlockSpec revisits one supertile block per run of
+grid steps via a scalar-prefetched supertile-id, so accumulation happens
+in VMEM and each block hits HBM exactly once per sweep.  Empty supertiles
+get a dummy all-padding group so every output block is initialized.
+
+Per-edge metadata is packed into two int32 arrays (source row; and
+lane|bit|dst_lane|dst_sub) to halve HBM streaming per sweep.
 
 Semantics are identical to ``trace_marks_np`` (the oracle for the
 reference's ShadowGraph.java:205-289): supervisor pointers are folded in
@@ -54,11 +64,12 @@ import numpy as np
 from . import trace as trace_ops
 
 LANE = 128  # lanes per vreg row
-ROWS = 8  # sublane rows per block
-SUPER = ROWS * LANE  # destination nodes per output block / edges per group
+ROWS = 8  # sublane rows per edge-slot block (8 * 128 edge slots per step)
 WORD_BITS = 32
-# Sentinel row for empty slots: beyond any table chunk, so they read 0.
+S_ROWS = 8  # default output sublane rows per block (s_rows * 128 dst nodes)
+# Sentinel row for empty slots: beyond any table chunk, so they never hit.
 _PAD_ROW = np.int32(1 << 28)
+_SPAN_BITS = 12  # chunk index / span fit in 12 bits up to ~134M actors
 
 
 def prepare_chunks(
@@ -67,6 +78,7 @@ def prepare_chunks(
     edge_weight: np.ndarray,
     supervisor: np.ndarray,
     n: int,
+    s_rows: int = S_ROWS,
 ) -> Dict[str, np.ndarray]:
     """Host-side packer: place propagation pairs into kernel blocks.
 
@@ -74,6 +86,8 @@ def prepare_chunks(
     lexsort of the live pairs, amortized across the trace's fixpoint
     iterations and across traces between graph mutations).
     """
+    assert 1 <= s_rows <= 32, "dst_sub is packed in 5 bits"
+    super_sz = s_rows * LANE
     live = edge_weight > 0
     psrc = edge_src[live].astype(np.int64)
     pdst = edge_dst[live].astype(np.int64)
@@ -82,33 +96,31 @@ def prepare_chunks(
         psrc = np.concatenate([psrc, sup_src])
         pdst = np.concatenate([pdst, supervisor[sup_src].astype(np.int64)])
 
-    n_super = max(1, -(-n // SUPER))
-    n_pad = n_super * SUPER
+    n_super = max(1, -(-n // super_sz))
+    n_pad = n_super * super_sz
     # Bit table geometry: R rows of 128 lanes of 32-bit words.
     n_words = -(-n_pad // WORD_BITS)
     r_rows = -(-n_words // LANE)
     r_rows = ((r_rows + ROWS - 1) // ROWS) * ROWS  # multiple of 8
+    assert r_rows // ROWS < (1 << _SPAN_BITS), "graph too large for span packing"
 
     m = psrc.size
     word = psrc >> 5
     w_row = (word >> 7).astype(np.int32)
     w_lane = (word & 127).astype(np.int32)
     w_bit = (psrc & 31).astype(np.int32)
-    d_super = (pdst // SUPER).astype(np.int64)
-    d_local = (pdst % SUPER).astype(np.int64)
+    d_super = (pdst // super_sz).astype(np.int64)
+    d_local = (pdst % super_sz).astype(np.int64)
     r8 = (w_row & 7).astype(np.int64)
 
     # --- placement -----------------------------------------------------
-    # Sort by (dst supertile, row%8 class); rank within each class gives
-    # a (block-in-supertile, column) slot such that each column holds at
-    # most one edge per class — the lane-binding is then conflict-free.
-    order = np.lexsort((r8, d_super))
-    psrc, w_row, w_lane, w_bit = (
-        psrc[order],
-        w_row[order],
-        w_lane[order],
-        w_bit[order],
-    )
+    # Sort by (dst supertile, row%8 class, source row); rank within each
+    # class gives (block-in-supertile, column) such that each column holds
+    # at most one edge per class — the slot row can then be the class
+    # itself — and each block's 128-edge runs are source-sorted, keeping
+    # its table-chunk span narrow.
+    order = np.lexsort((w_row, r8, d_super))
+    w_row, w_lane, w_bit = w_row[order], w_lane[order], w_bit[order]
     d_super, d_local, r8 = d_super[order], d_local[order], r8[order]
 
     # rank of each edge within its (d_super, r8) class
@@ -124,84 +136,77 @@ def prepare_chunks(
     # blocks needed per supertile = max over classes of ceil(class/128)
     blocks_needed = np.zeros(n_super, dtype=np.int64)
     if m:
-        per_class_blocks = rank // LANE + 1
-        np.maximum.at(
-            blocks_needed, d_super, per_class_blocks
-        )
+        np.maximum.at(blocks_needed, d_super, rank // LANE + 1)
     blocks_needed = np.maximum(blocks_needed, 1)  # dummy for empty supertiles
 
     n_blocks = int(blocks_needed.sum())
     block_base = np.zeros(n_super, dtype=np.int64)
     block_base[1:] = np.cumsum(blocks_needed)[:-1]
 
-    if m:
-        g_block = block_base[d_super] + rank // LANE
-        col = rank % LANE
-        # slot within (block, col): edges there have distinct r8; order by
-        # r8 via a second pass
-        slot_key = g_block * LANE + col
-        order2 = np.lexsort((r8, slot_key))
-        inv = np.empty(m, dtype=np.int64)
-        sk_sorted = slot_key[order2]
-        change2 = np.ones(m, dtype=bool)
-        change2[1:] = sk_sorted[1:] != sk_sorted[:-1]
-        start2 = np.nonzero(change2)[0]
-        starts2 = np.repeat(start2, np.diff(np.append(start2, m)))
-        slot_sorted = np.arange(m, dtype=np.int64) - starts2
-        inv[order2] = slot_sorted
-        slot = inv  # per-edge sublane slot in its (block, col)
-    else:
-        g_block = np.zeros(0, dtype=np.int64)
-        col = np.zeros(0, dtype=np.int64)
-        slot = np.zeros(0, dtype=np.int64)
-
-    assert not m or slot.max() < ROWS, "placement overflow: >8 classes per column"
-
     # --- fill kernel arrays -------------------------------------------
     shape = (n_blocks * ROWS, LANE)
     row_pos = np.full(shape, _PAD_ROW, dtype=np.int32)
-    lane_idx = np.zeros(shape, dtype=np.int32)
-    bit_pos = np.zeros(shape, dtype=np.int32)
-    dst_sub = np.zeros(shape, dtype=np.int32)
-    dst_lane = np.zeros(shape, dtype=np.int32)
+    emeta = np.zeros(shape, dtype=np.int32)
 
     if m:
-        ri = g_block * ROWS + slot
+        g_block = block_base[d_super] + rank // LANE
+        col = rank % LANE
+        ri = g_block * ROWS + r8  # slot row = source row mod 8
         row_pos[ri, col] = w_row
-        bit_pos[ri, col] = w_bit
-        dst_sub[ri, col] = (d_local >> 7).astype(np.int32)
-        dst_lane[ri, col] = (d_local & 127).astype(np.int32)
-        # lane binding: consulted at (row_e % 8, col)
-        li = g_block * ROWS + r8
-        lane_idx[li, col] = w_lane
+        emeta[ri, col] = (
+            w_lane
+            | (w_bit << 7)
+            | ((d_local & 127).astype(np.int32) << 12)
+            | ((d_local >> 7).astype(np.int32) << 19)
+        )
+        # per-block table-chunk range
+        chunk = (w_row >> 3).astype(np.int64)
+        c_lo = np.full(n_blocks, 1 << 30, dtype=np.int64)
+        c_hi = np.zeros(n_blocks, dtype=np.int64)
+        np.minimum.at(c_lo, g_block, chunk)
+        np.maximum.at(c_hi, g_block, chunk + 1)
+        empty = c_lo > c_hi
+        c_lo[empty] = 0
+        c_hi[empty] = 0
+    else:
+        c_lo = np.zeros(n_blocks, dtype=np.int64)
+        c_hi = np.zeros(n_blocks, dtype=np.int64)
 
-    block_super = np.repeat(
-        np.arange(n_super, dtype=np.int32), blocks_needed
-    )
-    block_first = np.zeros(n_blocks, dtype=np.int32)
+    span = c_hi - c_lo
+    assert span.max(initial=0) < (1 << _SPAN_BITS)
+
+    block_super = np.repeat(np.arange(n_super, dtype=np.int64), blocks_needed)
+    block_first = np.zeros(n_blocks, dtype=np.int64)
     block_first[block_base] = 1
+
+    # meta1 = supertile id | first-visit bit; meta2 = chunk range
+    bmeta1 = (block_super << 1 | block_first).astype(np.int32)
+    bmeta2 = (c_lo << _SPAN_BITS | span).astype(np.int32)
 
     return {
         "row_pos": row_pos,
-        "lane_idx": lane_idx,
-        "bit_pos": bit_pos,
-        "dst_sub": dst_sub,
-        "dst_lane": dst_lane,
-        "super": block_super,
-        "first": block_first,
+        "emeta": emeta,
+        "bmeta1": bmeta1,
+        "bmeta2": bmeta2,
         "n_super": n_super,
         "n_blocks": n_blocks,
         "r_rows": r_rows,
         "n_pad": n_pad,
         "n": n,
+        "s_rows": s_rows,
     }
+
+
+def device_args(prep: Dict[str, np.ndarray]) -> tuple:
+    """The kernel operands (after flags/recv) in call order."""
+    return (prep["bmeta1"], prep["bmeta2"], prep["row_pos"], prep["emeta"])
 
 
 _fn_cache: Dict[tuple, object] = {}
 
 
 def _build_trace_fn(
-    n: int, n_blocks: int, n_super: int, r_rows: int, interpret: bool
+    n: int, n_blocks: int, n_super: int, r_rows: int, s_rows: int, interpret: bool
 ):
     import jax
     import jax.numpy as jnp
@@ -209,84 +214,84 @@ def _build_trace_fn(
     from jax.experimental.pallas import tpu as pltpu
 
     F = trace_ops
-    n_chunks = r_rows // ROWS
 
-    def kernel(
-        sup_ref,
-        first_ref,
-        table_ref,
-        row_ref,
-        laneidx_ref,
-        bit_ref,
-        dsub_ref,
-        dlane_ref,
-        out_ref,
-    ):
+    def kernel(meta1_ref, meta2_ref, table_ref, row_ref, emeta_ref, out_ref):
         i = pl.program_id(0)
-        row_pos = row_ref[:]
-        lane_idx = laneidx_ref[:]
+        m2 = meta2_ref[i]
+        c_lo = jax.lax.shift_right_logical(m2, _SPAN_BITS)
+        span = m2 & ((1 << _SPAN_BITS) - 1)
 
-        def chunk_body(c, acc):
+        row_pos = row_ref[:]
+        emeta = emeta_ref[:]
+        lane_idx = emeta & 127
+        bit_pos = (emeta >> 7) & 31
+        dst_lane = (emeta >> 12) & 127
+        dst_sub = (emeta >> 19) & 31
+        row_iota = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANE), 0)
+
+        def chunk_body(k, acc):
+            c = c_lo + k
             tab_c = table_ref[pl.ds(c * ROWS, ROWS), :]
-            g1 = jnp.take_along_axis(tab_c, lane_idx, axis=1)
-            row_rel = row_pos - c * ROWS
-            row_sel = jnp.clip(row_rel, 0, ROWS - 1)
-            g2 = jnp.take_along_axis(g1, row_sel, axis=0)
-            hit = (row_rel >= 0) & (row_rel < ROWS)
-            return jnp.where(hit, g2, acc)
+            g = jnp.take_along_axis(tab_c, lane_idx, axis=1)
+            hit = (row_pos - c * ROWS) == row_iota
+            return jnp.where(hit, g, acc)
 
         words = jax.lax.fori_loop(
-            0, n_chunks, chunk_body, jnp.zeros((ROWS, LANE), jnp.int32)
+            0, span, chunk_body, jnp.zeros((ROWS, LANE), jnp.int32)
         )
-        bits = jax.lax.shift_right_logical(words, bit_ref[:]) & 1
-        vals = bits.astype(jnp.float32)
+        bits = jax.lax.shift_right_logical(words, bit_pos) & 1
+        vals = bits.astype(jnp.bfloat16)
 
-        sub_iota = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANE), 0)
+        # Fused one-hot segment-sum on the MXU: one (s_rows, 1024) @
+        # (1024, 128) contraction per block.
+        sub_iota = jax.lax.broadcasted_iota(jnp.int32, (s_rows, LANE), 0)
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
-        acc = jnp.zeros((ROWS, LANE), jnp.float32)
+        zero_a = jnp.zeros((s_rows, LANE), jnp.bfloat16)
+        a_parts = []
+        b_parts = []
         for r in range(ROWS):
-            vals_r = vals[r, :]
-            a = jnp.where(sub_iota == dsub_ref[r, :][None, :], vals_r[None, :], 0.0)
-            b = jnp.where(lane_iota == dlane_ref[r, :][:, None], 1.0, 0.0)
-            acc = acc + jnp.dot(a, b, preferred_element_type=jnp.float32)
+            a_parts.append(
+                jnp.where(sub_iota == dst_sub[r, :][None, :], vals[r, :][None, :], zero_a)
+            )
+            b_parts.append(
+                (lane_iota == dst_lane[r, :][:, None]).astype(jnp.bfloat16)
+            )
+        a = jnp.concatenate(a_parts, axis=1)  # (s_rows, ROWS*LANE)
+        b = jnp.concatenate(b_parts, axis=0)  # (ROWS*LANE, LANE)
+        acc = jnp.dot(a, b, preferred_element_type=jnp.float32)
 
-        @pl.when(first_ref[i] == 1)
+        @pl.when((meta1_ref[i] & 1) == 1)
         def _():
             out_ref[:] = acc
 
-        @pl.when(first_ref[i] == 0)
+        @pl.when((meta1_ref[i] & 1) == 0)
         def _():
             out_ref[:] = out_ref[:] + acc
 
-    blockmap = pl.BlockSpec((ROWS, LANE), lambda i, sup, first: (i, 0))
+    blockmap = pl.BlockSpec((ROWS, LANE), lambda i, m1, m2: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(n_blocks,),
         in_specs=[
             # bit table: whole array, VMEM-resident across all steps
-            pl.BlockSpec((r_rows, LANE), lambda i, sup, first: (0, 0)),
+            pl.BlockSpec((r_rows, LANE), lambda i, m1, m2: (0, 0)),
             blockmap,  # row_pos
-            blockmap,  # lane_idx
-            blockmap,  # bit_pos
-            blockmap,  # dst_sub
-            blockmap,  # dst_lane
+            blockmap,  # emeta
         ],
-        out_specs=pl.BlockSpec((ROWS, LANE), lambda i, sup, first: (sup[i], 0)),
+        out_specs=pl.BlockSpec(
+            (s_rows, LANE), lambda i, m1, m2: (m1[i] >> 1, 0)
+        ),
     )
     propagate = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_super * ROWS, LANE), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_super * s_rows, LANE), jnp.float32),
         interpret=interpret,
     )
 
-    n_pad = n_super * SUPER
     n_words_pad = r_rows * LANE
 
-    def trace_fn(
-        flags, recv_count, block_super, block_first, row_pos, lane_idx,
-        bit_pos, dst_sub, dst_lane,
-    ):
+    def trace_fn(flags, recv_count, bmeta1, bmeta2, row_pos, emeta):
         in_use = (flags & F.FLAG_IN_USE) != 0
         halted = (flags & F.FLAG_HALTED) != 0
         seed = (
@@ -314,10 +319,7 @@ def _build_trace_fn(
         def body(carry):
             mark, _ = carry
             table = pack(mark & (~halted))
-            contrib = propagate(
-                block_super, block_first, table, row_pos, lane_idx,
-                bit_pos, dst_sub, dst_lane,
-            )
+            contrib = propagate(bmeta1, bmeta2, table, row_pos, emeta)
             hits = contrib.reshape(-1)[:n] > 0
             new_mark = mark | (hits & in_use)
             changed = jnp.any(new_mark != mark)
@@ -337,11 +339,23 @@ def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
 
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    key = (prep["n"], prep["n_blocks"], prep["n_super"], prep["r_rows"], interpret)
+    key = (
+        prep["n"],
+        prep["n_blocks"],
+        prep["n_super"],
+        prep["r_rows"],
+        prep["s_rows"],
+        interpret,
+    )
     fn = _fn_cache.get(key)
     if fn is None:
         fn = _build_trace_fn(
-            prep["n"], prep["n_blocks"], prep["n_super"], prep["r_rows"], interpret
+            prep["n"],
+            prep["n_blocks"],
+            prep["n_super"],
+            prep["r_rows"],
+            prep["s_rows"],
+            interpret,
         )
         _fn_cache[key] = fn
     return fn
@@ -351,17 +365,7 @@ def trace_marks_prepared(flags, recv_count, prep: Dict[str, np.ndarray]) -> np.n
     """Run the Pallas-backed trace against pre-packed pair arrays."""
     n = prep["n"]
     fn = get_trace_fn(prep)
-    out = fn(
-        flags[:n],
-        recv_count[:n],
-        prep["super"],
-        prep["first"],
-        prep["row_pos"],
-        prep["lane_idx"],
-        prep["bit_pos"],
-        prep["dst_sub"],
-        prep["dst_lane"],
-    )
+    out = fn(flags[:n], recv_count[:n], *device_args(prep))
     return np.asarray(out)
 
 

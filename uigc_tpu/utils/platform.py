@@ -1,0 +1,22 @@
+"""Platform-selection helper for entry points.
+
+A TPU plugin on this host can win JAX platform selection over the
+``JAX_PLATFORMS`` env var; only the config API reliably overrides it, and
+it must run before the first backend initialization.  Entry points call
+this right after ``import jax``; an explicit TPU request is left alone.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> None:
+    env = os.environ.get("JAX_PLATFORMS", "")
+    if env and "tpu" not in env.lower():
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", env)
+        except Exception:
+            pass
