@@ -88,6 +88,27 @@ def test_cycle_collection_all_backends(backend):
         kit.shutdown()
 
 
+def test_cycle_collection_device_pallas(monkeypatch):
+    """The device backend's Pallas trace path, forced on CPU (interpret
+    mode) by faking the platform check; same lifecycle contract."""
+    from uigc_tpu.engines.crgc.arrays import ArrayShadowGraph
+
+    monkeypatch.setattr(ArrayShadowGraph, "_on_tpu", lambda self: True)
+    kit = ActorTestKit(
+        {"uigc.crgc.wakeup-interval": 10, "uigc.crgc.shadow-graph": "device"}
+    )
+    try:
+        probe = kit.create_test_probe(timeout_s=60.0)
+        root = kit.spawn(Behaviors.setup_root(lambda ctx: Root(ctx, probe)), "root")
+        probe.expect_message_type(Spawned)
+        probe.expect_message_type(Spawned)
+        root.tell(Drop())
+        probe.expect_message_type(Stopped)
+        probe.expect_message_type(Stopped)
+    finally:
+        kit.shutdown()
+
+
 class LoneRoot(AbstractBehavior):
     """A root that spawns workers, never releases them, then stops itself."""
 

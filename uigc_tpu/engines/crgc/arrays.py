@@ -77,6 +77,12 @@ class ArrayShadowGraph:
         self.out_edges: List[Set[int]] = [set() for _ in range(cap)]
         self.in_edges: List[Set[int]] = [set() for _ in range(cap)]
 
+        #: bumped on every topology change (edges, supervisors, growth);
+        #: the Pallas packer's pair layout is cached against it
+        self._topo_version = 0
+        self._prep_version = -1
+        self._prep = None
+
     # ------------------------------------------------------------- #
     # Capacity management (static-shape friendly: powers of two)
     # ------------------------------------------------------------- #
@@ -97,6 +103,7 @@ class ArrayShadowGraph:
         self.in_edges.extend(set() for _ in range(old))
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
+        self._topo_version += 1
 
     def _grow_edges(self) -> None:
         old = self.edge_capacity
@@ -108,6 +115,7 @@ class ArrayShadowGraph:
         )
         self.free_edges.extend(range(new - 1, old - 1, -1))
         self.edge_capacity = new
+        self._topo_version += 1
 
     # ------------------------------------------------------------- #
     # Interning
@@ -147,14 +155,24 @@ class ArrayShadowGraph:
             self.edge_weight[eid] = delta
             self.out_edges[owner].add(eid)
             self.in_edges[target].add(eid)
+            if delta > 0:
+                self._topo_version += 1
             return
-        w = self.edge_weight[eid] + delta
+        w_old = self.edge_weight[eid]
+        w = w_old + delta
         if w == 0:
             self._free_edge(eid)
         else:
             self.edge_weight[eid] = w
+            # The packer layout depends only on edge *liveness* (weight
+            # sign), not magnitude; don't invalidate the prep cache for
+            # plain message-count deltas.
+            if (w_old > 0) != (w > 0):
+                self._topo_version += 1
 
     def _free_edge(self, eid: int) -> None:
+        if self.edge_weight[eid] > 0:
+            self._topo_version += 1
         owner = int(self.edge_src[eid])
         target = int(self.edge_dst[eid])
         self.edge_of.pop((owner, target), None)
@@ -198,7 +216,9 @@ class ArrayShadowGraph:
             if child is None:
                 break
             child_slot = self.slot_for(child.target)
-            self.supervisor[child_slot] = self_slot
+            if self.supervisor[child_slot] != self_slot:
+                self.supervisor[child_slot] = self_slot
+                self._topo_version += 1
 
         for i in range(field_size):
             target = entry.updated_refs[i]
@@ -231,7 +251,9 @@ class ArrayShadowGraph:
                     self.flags[slot] &= ~_F.FLAG_ROOT
             self.recv_count[slot] += delta_shadow.recv_count
             if delta_shadow.supervisor >= 0:
-                self.supervisor[slot] = slots[delta_shadow.supervisor]
+                if self.supervisor[slot] != slots[delta_shadow.supervisor]:
+                    self.supervisor[slot] = slots[delta_shadow.supervisor]
+                    self._topo_version += 1
             for target_id, count in delta_shadow.outgoing.items():
                 self._update_edge(slot, slots[target_id], count)
 
@@ -269,6 +291,8 @@ class ArrayShadowGraph:
     def compute_marks(self) -> np.ndarray:
         if self.use_device:
             with events.recorder.timed(events.DEVICE_TRACE):
+                if self._on_tpu():
+                    return self._compute_marks_pallas()
                 return trace_ops.trace_marks_jax(
                     self.flags,
                     self.recv_count,
@@ -284,6 +308,37 @@ class ArrayShadowGraph:
             self.edge_src,
             self.edge_dst,
             self.edge_weight,
+        )
+
+    def _on_tpu(self) -> bool:
+        tpu = getattr(self, "_is_tpu", None)
+        if tpu is None:
+            import jax
+
+            tpu = self._is_tpu = jax.devices()[0].platform == "tpu"
+        return tpu
+
+    def _compute_marks_pallas(self) -> np.ndarray:
+        """Device trace through the Pallas propagation kernel.
+
+        The packer's pair layout depends only on topology (edges +
+        supervisors), so it is cached against ``_topo_version`` and
+        rebuilt lazily; block counts are padded to powers of two so a
+        mutating graph causes at most log-many kernel recompiles."""
+        from ...ops import pallas_trace
+
+        if self._prep_version != self._topo_version:
+            self._prep = pallas_trace.prepare_chunks(
+                self.edge_src,
+                self.edge_dst,
+                self.edge_weight,
+                self.supervisor,
+                self.capacity,
+                pad_blocks_pow2=True,
+            )
+            self._prep_version = self._topo_version
+        return pallas_trace.trace_marks_prepared(
+            self.flags, self.recv_count, self._prep
         )
 
     def trace(self, should_kill: bool) -> int:
@@ -314,7 +369,9 @@ class ArrayShadowGraph:
         self.locations[slot] = None
         self.flags[slot] = 0
         self.recv_count[slot] = 0
-        self.supervisor[slot] = -1
+        if self.supervisor[slot] != -1:
+            self.supervisor[slot] = -1
+            self._topo_version += 1
         for eid in list(self.out_edges[slot]):
             self._free_edge(eid)
         for eid in list(self.in_edges[slot]):

@@ -79,12 +79,18 @@ def prepare_chunks(
     supervisor: np.ndarray,
     n: int,
     s_rows: int = S_ROWS,
+    pad_blocks_pow2: bool = False,
 ) -> Dict[str, np.ndarray]:
     """Host-side packer: place propagation pairs into kernel blocks.
 
     Rebuild whenever the edge set or supervisor pointers change (one
     lexsort of the live pairs, amortized across the trace's fixpoint
     iterations and across traces between graph mutations).
+
+    ``pad_blocks_pow2`` rounds the block count up to a power of two with
+    inert padding blocks (they re-accumulate zeros into the last
+    supertile), so a live, mutating graph triggers at most log-many
+    kernel recompiles instead of one per edge-set change.
     """
     assert 1 <= s_rows <= 32, "dst_sub is packed in 5 bits"
     super_sz = s_rows * LANE
@@ -178,6 +184,28 @@ def prepare_chunks(
     block_super = np.repeat(np.arange(n_super, dtype=np.int64), blocks_needed)
     block_first = np.zeros(n_blocks, dtype=np.int64)
     block_first[block_base] = 1
+
+    if pad_blocks_pow2:
+        padded = 1 << max(0, int(n_blocks - 1).bit_length())
+        if padded > n_blocks:
+            extra = padded - n_blocks
+            # Inert blocks: span 0 (no gather), accumulate zeros into the
+            # last supertile (keeps output revisits consecutive).
+            block_super = np.concatenate(
+                [block_super, np.full(extra, n_super - 1, dtype=np.int64)]
+            )
+            block_first = np.concatenate(
+                [block_first, np.zeros(extra, dtype=np.int64)]
+            )
+            c_lo = np.concatenate([c_lo, np.zeros(extra, dtype=np.int64)])
+            span = np.concatenate([span, np.zeros(extra, dtype=np.int64)])
+            row_pos = np.concatenate(
+                [row_pos, np.full((extra * ROWS, LANE), _PAD_ROW, np.int32)]
+            )
+            emeta = np.concatenate(
+                [emeta, np.zeros((extra * ROWS, LANE), np.int32)]
+            )
+            n_blocks = padded
 
     # meta1 = supertile id | first-visit bit; meta2 = chunk range
     bmeta1 = (block_super << 1 | block_first).astype(np.int32)

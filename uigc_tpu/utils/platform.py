@@ -11,8 +11,10 @@ from __future__ import annotations
 import os
 
 
-def apply_platform_override() -> None:
-    env = os.environ.get("JAX_PLATFORMS", "")
+def apply_platform_override(default: str | None = None) -> None:
+    """Apply ``JAX_PLATFORMS`` (or ``default`` when unset/empty) through
+    the config API.  An explicit TPU request is honored as-is."""
+    env = os.environ.get("JAX_PLATFORMS") or default
     if env and "tpu" not in env.lower():
         import jax
 
