@@ -7,6 +7,16 @@ The north-star target (BASELINE.json) is >=10M garbage actors/sec with
 <=10ms p50 detection latency at a 10M-actor graph; vs_baseline is
 throughput relative to that 10M/s target (no published reference numbers
 exist — BASELINE.md documents the absence).
+
+``--config`` selects the other BASELINE workloads, which drive the live
+actor runtime end to end instead of the raw device kernel:
+  churn    (1) CRGC, acyclic ownership tree of 10k actors
+  mac      (2) MAC weighted-refcount, flat acyclic garbage
+  rings    (3) CRGC cyclic garbage: 100 rings of 100 actors
+  cluster  (4) CRGC 3-node crash recovery with injected message drops
+  powerlaw (5) the default: batched device trace on a 10M-actor graph
+Configs 1-4 report end-to-end collected actors/sec; no reference numbers
+exist to normalize against, so their vs_baseline is null.
 """
 
 import argparse
@@ -28,7 +38,17 @@ def main() -> None:
         default=None,
         help="trace implementation (default: pallas on TPU, xla elsewhere)",
     )
+    parser.add_argument(
+        "--config",
+        choices=["powerlaw", "churn", "mac", "rings", "cluster"],
+        default="powerlaw",
+        help="BASELINE workload config (default: powerlaw, config 5)",
+    )
     args = parser.parse_args()
+
+    if args.config != "powerlaw":
+        run_live_config(args)
+        return
 
     import jax
 
@@ -170,6 +190,36 @@ def main() -> None:
         "timing_reps": reps,
         "platform": platform,
         "impl": impl,
+    }
+    print(json.dumps(result))
+
+
+def run_live_config(args) -> None:
+    """BASELINE configs 1-4: end-to-end collection through the live
+    runtime (see uigc_tpu/models/workloads.py)."""
+    from uigc_tpu.models import workloads
+
+    n = args.n
+    if args.config == "churn":
+        r = workloads.run_tree(n_actors=n or 10_000, fanout=8, engine="crgc")
+    elif args.config == "mac":
+        r = workloads.run_tree(n_actors=n or 10_000, fanout=1 << 30, engine="mac")
+    elif args.config == "rings":
+        rings = max(1, (n or 10_000) // 100)
+        r = workloads.run_rings(n_rings=rings, ring_size=100)
+    else:  # cluster
+        r = workloads.run_cluster_recovery(n_workers=n or 200)
+
+    throughput = r["n_collected"] / r["collect_s"]
+    result = {
+        "metric": f"{args.config}_collected_actors_per_sec",
+        "value": round(throughput, 1),
+        "unit": "actors/s",
+        "vs_baseline": None,  # no reference numbers exist (BASELINE.md)
+        "collect_s": round(r["collect_s"], 3),
+        "build_s": round(r["build_s"], 3),
+        "n_collected": r["n_collected"],
+        "config": args.config,
     }
     print(json.dumps(result))
 

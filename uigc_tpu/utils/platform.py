@@ -16,6 +16,9 @@ def apply_platform_override(default: str | None = None) -> None:
     the config API.  An explicit TPU request is honored as-is."""
     env = os.environ.get("JAX_PLATFORMS") or default
     if env and "tpu" not in env.lower():
+        # Also export the env var so JAX's own platform resolution at
+        # first backend init picks it up even if the config call fails.
+        os.environ["JAX_PLATFORMS"] = env
         import jax
 
         try:
