@@ -49,6 +49,10 @@ DEFAULTS: Dict[str, Any] = {
     # detector is a stub (reference.conf:48); ours implements SCC-based
     # detection and this flag gates the kill decision.
     "uigc.mac.collect-cycles": True,
+    # Blocked-candidate count at which the cycle detector switches from
+    # host Tarjan to the device SCC kernel (ops/scc.py).  0 forces the
+    # device path; large values keep detection host-side.
+    "uigc.mac.device-scc-threshold": 4096,
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,

@@ -135,6 +135,7 @@ class CycleDetector(RawBehavior):
         self.total_entries = 0
         self.total_cycles_collected = 0
         self._timer_keys: list = []
+        self.device_scc_threshold = 1 << 30  # set from config in bind()
         #: blocked actors and their latest BLK snapshot
         self.blocked: Dict[Any, BLK] = {}
         #: outstanding confirmation: token -> (members, acks-received)
@@ -143,6 +144,9 @@ class CycleDetector(RawBehavior):
 
     def bind(self, cell: Any) -> None:
         self.cell = cell
+        self.device_scc_threshold = self.engine.system.config.get_int(
+            "uigc.mac.device-scc-threshold"
+        )
         interval_s = self.engine.system.config.get_int("uigc.mac.wakeup-interval") / 1000.0
         key = ("mac-wakeup", id(self))
         self._timer_keys.append(key)
@@ -214,7 +218,11 @@ class CycleDetector(RawBehavior):
             cell: [t for t, w in blk.actor_map if t in candidates and w > 0]
             for cell, blk in candidates.items()
         }
-        for scc in strongly_connected_components(list(candidates), edges):
+        if len(candidates) >= self.device_scc_threshold:
+            sccs = self._device_sccs(candidates, edges)
+        else:
+            sccs = strongly_connected_components(list(candidates), edges)
+        for scc in sccs:
             scc_set = set(scc)
             if not self._is_closed(scc_set, candidates):
                 continue
@@ -222,6 +230,44 @@ class CycleDetector(RawBehavior):
             self.pending[token] = (scc_set, set())
             for member in scc:
                 member.tell(CNF(token))
+
+    def _device_sccs(
+        self, candidates: Dict[Any, Any], edges: Dict[Any, List[Any]]
+    ) -> List[List[Any]]:
+        """SCCs via the device kernel (ops/scc.py) for large blocked sets.
+
+        Node and edge counts are padded to powers of two (inactive slots /
+        invalid endpoints), so the jitted kernel recompiles at most
+        log-many times as the blocked population grows."""
+        import numpy as np
+
+        from ...ops import scc as scc_ops
+
+        cells = list(candidates)
+        index = {cell: i for i, cell in enumerate(cells)}
+        src = []
+        dst = []
+        for cell, targets in edges.items():
+            i = index[cell]
+            for t in targets:
+                src.append(i)
+                dst.append(index[t])
+
+        n = len(cells)
+        n_pad = 1 << max(0, (n - 1).bit_length())
+        m_pad = 1 << max(0, (max(1, len(src)) - 1).bit_length())
+        active = np.zeros(n_pad, dtype=bool)
+        active[:n] = True
+        src_a = np.full(m_pad, -1, dtype=np.int32)
+        dst_a = np.full(m_pad, -1, dtype=np.int32)
+        src_a[: len(src)] = src
+        dst_a[: len(dst)] = dst
+
+        labels = scc_ops.scc_labels_jax(n_pad, src_a, dst_a, active)
+        groups: Dict[int, List[Any]] = {}
+        for i, cell in enumerate(cells):
+            groups.setdefault(int(labels[i]), []).append(cell)
+        return list(groups.values())
 
     def _is_closed(self, scc: Set[Any], candidates: Dict[Any, BLK]) -> bool:
         """A cycle is closed iff for every member, rc + RC_INC equals the
