@@ -126,7 +126,7 @@ def run_tree(
                 self.tops = []
             return self
 
-    cfg = {"uigc.engine": engine, "uigc.crgc.wakeup-interval": 10}
+    cfg = {"uigc.engine": engine, f"uigc.{engine}.wakeup-interval": 10}
     cfg.update(config or {})
     system = ActorSystem(None, name="bench-tree", config=cfg)
     try:
