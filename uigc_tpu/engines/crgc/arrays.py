@@ -28,6 +28,7 @@ from typing import TYPE_CHECKING, Dict, List, Optional, Set
 
 import numpy as np
 
+from ...ops import pallas_incremental as pallas_incremental_kinds
 from ...ops import trace as trace_ops
 from ...utils import events
 from .messages import StopMsg, WaveMsg
@@ -38,6 +39,8 @@ if TYPE_CHECKING:  # pragma: no cover
     from .refob import CrgcRefob
 
 _F = trace_ops
+_PAIR_EDGE = pallas_incremental_kinds.EDGE
+_PAIR_SUP = pallas_incremental_kinds.SUP
 
 
 class ArrayShadowGraph:
@@ -77,11 +80,12 @@ class ArrayShadowGraph:
         self.out_edges: List[Set[int]] = [set() for _ in range(cap)]
         self.in_edges: List[Set[int]] = [set() for _ in range(cap)]
 
-        #: bumped on every topology change (edges, supervisors, growth);
-        #: the Pallas packer's pair layout is cached against it
-        self._topo_version = 0
-        self._prep_version = -1
-        self._prep = None
+        #: changelog of pair transitions since the Pallas layout last
+        #: consumed it: (insert?, src, dst, kind).  ``None`` = too much
+        #: churn (or geometry change) — do a full repack instead.
+        self._pair_log: Optional[List[tuple]] = []
+        self._log_cap = 1 << 20
+        self._inc = None  # lazily-built IncrementalPallasLayout
 
     # ------------------------------------------------------------- #
     # Capacity management (static-shape friendly: powers of two)
@@ -103,7 +107,10 @@ class ArrayShadowGraph:
         self.in_edges.extend(set() for _ in range(old))
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
-        self._topo_version += 1
+        # Node capacity sets the bit-table/supertile geometry: the whole
+        # Pallas layout must be rebuilt.
+        self._pair_log = None
+        self._inc = None
 
     def _grow_edges(self) -> None:
         old = self.edge_capacity
@@ -115,7 +122,6 @@ class ArrayShadowGraph:
         )
         self.free_edges.extend(range(new - 1, old - 1, -1))
         self.edge_capacity = new
-        self._topo_version += 1
 
     # ------------------------------------------------------------- #
     # Interning
@@ -139,6 +145,17 @@ class ArrayShadowGraph:
         self.supervisor[slot] = -1
         return slot
 
+    def _log_pair(self, insert: bool, src: int, dst: int, kind: int) -> None:
+        """Record a live-pair transition for the incremental Pallas
+        layout; collapse to a full-rebuild sentinel under extreme churn."""
+        log = self._pair_log
+        if log is None:
+            return
+        if len(log) >= self._log_cap:
+            self._pair_log = None
+            return
+        log.append((insert, src, dst, kind))
+
     def _update_edge(self, owner: int, target: int, delta: int) -> None:
         """Zero-count edges are deleted (reference: ShadowGraph.java:64-73)."""
         key = (owner, target)
@@ -156,7 +173,7 @@ class ArrayShadowGraph:
             self.out_edges[owner].add(eid)
             self.in_edges[target].add(eid)
             if delta > 0:
-                self._topo_version += 1
+                self._log_pair(True, owner, target, _PAIR_EDGE)
             return
         w_old = self.edge_weight[eid]
         w = w_old + delta
@@ -165,21 +182,31 @@ class ArrayShadowGraph:
         else:
             self.edge_weight[eid] = w
             # The packer layout depends only on edge *liveness* (weight
-            # sign), not magnitude; don't invalidate the prep cache for
+            # sign), not magnitude; don't invalidate the layout for
             # plain message-count deltas.
             if (w_old > 0) != (w > 0):
-                self._topo_version += 1
+                self._log_pair(w > 0, owner, target, _PAIR_EDGE)
 
     def _free_edge(self, eid: int) -> None:
-        if self.edge_weight[eid] > 0:
-            self._topo_version += 1
         owner = int(self.edge_src[eid])
         target = int(self.edge_dst[eid])
+        if self.edge_weight[eid] > 0:
+            self._log_pair(False, owner, target, _PAIR_EDGE)
         self.edge_of.pop((owner, target), None)
         self.edge_weight[eid] = 0
         self.out_edges[owner].discard(eid)
         self.in_edges[target].discard(eid)
         self.free_edges.append(eid)
+
+    def _set_supervisor(self, child_slot: int, new_sup: int) -> None:
+        old = int(self.supervisor[child_slot])
+        if old == new_sup:
+            return
+        if old >= 0:
+            self._log_pair(False, child_slot, old, _PAIR_SUP)
+        if new_sup >= 0:
+            self._log_pair(True, child_slot, new_sup, _PAIR_SUP)
+        self.supervisor[child_slot] = new_sup
 
     # ------------------------------------------------------------- #
     # Folding entries (reference: ShadowGraph.java:75-125)
@@ -216,9 +243,7 @@ class ArrayShadowGraph:
             if child is None:
                 break
             child_slot = self.slot_for(child.target)
-            if self.supervisor[child_slot] != self_slot:
-                self.supervisor[child_slot] = self_slot
-                self._topo_version += 1
+            self._set_supervisor(child_slot, self_slot)
 
         for i in range(field_size):
             target = entry.updated_refs[i]
@@ -251,9 +276,7 @@ class ArrayShadowGraph:
                     self.flags[slot] &= ~_F.FLAG_ROOT
             self.recv_count[slot] += delta_shadow.recv_count
             if delta_shadow.supervisor >= 0:
-                if self.supervisor[slot] != slots[delta_shadow.supervisor]:
-                    self.supervisor[slot] = slots[delta_shadow.supervisor]
-                    self._topo_version += 1
+                self._set_supervisor(slot, slots[delta_shadow.supervisor])
             for target_id, count in delta_shadow.outgoing.items():
                 self._update_edge(slot, slots[target_id], count)
 
@@ -313,33 +336,50 @@ class ArrayShadowGraph:
     def _on_tpu(self) -> bool:
         tpu = getattr(self, "_is_tpu", None)
         if tpu is None:
-            import jax
+            from ...ops import pallas_trace
 
-            tpu = self._is_tpu = jax.devices()[0].platform == "tpu"
+            tpu = self._is_tpu = not pallas_trace.default_interpret()
         return tpu
 
     def _compute_marks_pallas(self) -> np.ndarray:
         """Device trace through the Pallas propagation kernel.
 
-        The packer's pair layout depends only on topology (edges +
-        supervisors), so it is cached against ``_topo_version`` and
-        rebuilt lazily; block counts are padded to powers of two so a
-        mutating graph causes at most log-many kernel recompiles."""
-        from ...ops import pallas_trace
+        Layout maintenance is incremental (ops/pallas_incremental.py):
+        pair transitions recorded in ``_pair_log`` are folded into the
+        cached base+delta layout in O(changes), so a churning graph no
+        longer pays a full O(E log E) repack before every wake.  A full
+        rebuild happens only on node-capacity growth, log overflow, or
+        when accumulated churn crosses the layout's repack threshold."""
+        from ...ops import pallas_incremental
 
-        if self._prep_version != self._topo_version:
-            self._prep = pallas_trace.prepare_chunks(
-                self.edge_src,
-                self.edge_dst,
-                self.edge_weight,
-                self.supervisor,
-                self.capacity,
-                pad_blocks_pow2=True,
+        inc = self._inc
+        if inc is None or self._pair_log is None:
+            if inc is None or inc.n != self.capacity:
+                # Only a geometry change needs a fresh object; a plain
+                # log overflow keeps the layout (and its stats/caches)
+                # and just repacks it.
+                inc = self._inc = pallas_incremental.IncrementalPallasLayout(
+                    self.capacity
+                )
+            inc.rebuild(
+                self.edge_src, self.edge_dst, self.edge_weight, self.supervisor
             )
-            self._prep_version = self._topo_version
-        return pallas_trace.trace_marks_prepared(
-            self.flags, self.recv_count, self._prep
-        )
+            self._pair_log = []
+        elif self._pair_log:
+            for insert, src, dst, kind in self._pair_log:
+                if insert:
+                    inc.insert(src, dst, kind)
+                else:
+                    inc.remove(src, dst, kind)
+            self._pair_log.clear()
+            if inc.needs_repack:
+                inc.rebuild(
+                    self.edge_src,
+                    self.edge_dst,
+                    self.edge_weight,
+                    self.supervisor,
+                )
+        return inc.trace(self.flags, self.recv_count)
 
     def trace(self, should_kill: bool) -> int:
         with events.recorder.timed(events.TRACING) as ev:
@@ -369,9 +409,7 @@ class ArrayShadowGraph:
         self.locations[slot] = None
         self.flags[slot] = 0
         self.recv_count[slot] = 0
-        if self.supervisor[slot] != -1:
-            self.supervisor[slot] = -1
-            self._topo_version += 1
+        self._set_supervisor(slot, -1)
         for eid in list(self.out_edges[slot]):
             self._free_edge(eid)
         for eid in list(self.in_edges[slot]):

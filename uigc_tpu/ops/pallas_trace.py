@@ -85,15 +85,15 @@ def prepare_chunks(
 
     Rebuild whenever the edge set or supervisor pointers change (one
     lexsort of the live pairs, amortized across the trace's fixpoint
-    iterations and across traces between graph mutations).
+    iterations and across traces between graph mutations; a live,
+    churning graph should use ops/pallas_incremental.py instead, which
+    keeps this full pack off the per-wake path).
 
     ``pad_blocks_pow2`` rounds the block count up to a power of two with
     inert padding blocks (they re-accumulate zeros into the last
     supertile), so a live, mutating graph triggers at most log-many
     kernel recompiles instead of one per edge-set change.
     """
-    assert 1 <= s_rows <= 32, "dst_sub is packed in 5 bits"
-    super_sz = s_rows * LANE
     live = edge_weight > 0
     psrc = edge_src[live].astype(np.int64)
     pdst = edge_dst[live].astype(np.int64)
@@ -101,6 +101,38 @@ def prepare_chunks(
     if sup_src.size:
         psrc = np.concatenate([psrc, sup_src])
         pdst = np.concatenate([pdst, supervisor[sup_src].astype(np.int64)])
+    return prepare_pairs(
+        psrc, pdst, n, s_rows=s_rows, pad_blocks_pow2=pad_blocks_pow2
+    )
+
+
+def prepare_pairs(
+    psrc: np.ndarray,
+    pdst: np.ndarray,
+    n: int,
+    s_rows: int = S_ROWS,
+    pad_blocks_pow2: bool = False,
+    want_slots: bool = False,
+    compact_supers: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Pack explicit propagation pairs (already filtered to live ones)
+    into kernel blocks.
+
+    With ``want_slots`` the result also carries ``slot_ri``/``slot_col``
+    — each input pair's (row, column) in ``row_pos``/``emeta``, aligned
+    with the *input* pair order — so a caller can later mask individual
+    pairs in place (the deletion path of the incremental layout).
+
+    With ``compact_supers`` the layout covers only the destination
+    supertiles this pair set actually touches: the kernel's output is
+    (k_touched * s_rows, LANE) and ``super_ids`` maps each compact tile
+    back to its global supertile.  Without it, a tiny delta layout over
+    a 10M-node space would still pay one (mostly dummy) grid step per
+    global supertile; with it the cost scales with the delta."""
+    assert 1 <= s_rows <= 32, "dst_sub is packed in 5 bits"
+    super_sz = s_rows * LANE
+    psrc = np.asarray(psrc, dtype=np.int64)
+    pdst = np.asarray(pdst, dtype=np.int64)
 
     n_super = max(1, -(-n // super_sz))
     n_pad = n_super * super_sz
@@ -118,6 +150,16 @@ def prepare_chunks(
     d_super = (pdst // super_sz).astype(np.int64)
     d_local = (pdst % super_sz).astype(np.int64)
     r8 = (w_row & 7).astype(np.int64)
+
+    if compact_supers:
+        touched = np.unique(d_super)
+        if touched.size == 0:
+            touched = np.zeros(1, dtype=np.int64)
+        d_super = np.searchsorted(touched, d_super)
+        n_tiles = int(touched.size)
+    else:
+        touched = None
+        n_tiles = n_super
 
     # --- placement -----------------------------------------------------
     # Sort by (dst supertile, row%8 class, source row); rank within each
@@ -139,14 +181,15 @@ def prepare_chunks(
     else:
         rank = np.zeros(0, dtype=np.int64)
 
-    # blocks needed per supertile = max over classes of ceil(class/128)
-    blocks_needed = np.zeros(n_super, dtype=np.int64)
+    # blocks needed per (compact) supertile = max over classes of
+    # ceil(class/128)
+    blocks_needed = np.zeros(n_tiles, dtype=np.int64)
     if m:
         np.maximum.at(blocks_needed, d_super, rank // LANE + 1)
     blocks_needed = np.maximum(blocks_needed, 1)  # dummy for empty supertiles
 
     n_blocks = int(blocks_needed.sum())
-    block_base = np.zeros(n_super, dtype=np.int64)
+    block_base = np.zeros(n_tiles, dtype=np.int64)
     block_base[1:] = np.cumsum(blocks_needed)[:-1]
 
     # --- fill kernel arrays -------------------------------------------
@@ -154,10 +197,17 @@ def prepare_chunks(
     row_pos = np.full(shape, _PAD_ROW, dtype=np.int32)
     emeta = np.zeros(shape, dtype=np.int32)
 
+    slot_ri = slot_col = None
     if m:
         g_block = block_base[d_super] + rank // LANE
         col = rank % LANE
         ri = g_block * ROWS + r8  # slot row = source row mod 8
+        if want_slots:
+            # Undo the placement sort: slot of the i-th *input* pair.
+            slot_ri = np.empty(m, dtype=np.int64)
+            slot_col = np.empty(m, dtype=np.int64)
+            slot_ri[order] = ri
+            slot_col[order] = col
         row_pos[ri, col] = w_row
         emeta[ri, col] = (
             w_lane
@@ -181,18 +231,44 @@ def prepare_chunks(
     span = c_hi - c_lo
     assert span.max(initial=0) < (1 << _SPAN_BITS)
 
-    block_super = np.repeat(np.arange(n_super, dtype=np.int64), blocks_needed)
+    block_super = np.repeat(np.arange(n_tiles, dtype=np.int64), blocks_needed)
     block_first = np.zeros(n_blocks, dtype=np.int64)
     block_first[block_base] = 1
+
+    if compact_supers and pad_blocks_pow2:
+        # Pad the compact tile count to a power of two so repeated delta
+        # packs reuse cached kernels.  Each pad tile gets one inert
+        # first-visit block (initializes its output to zero); the
+        # host-side scatter maps pad tiles to global supertile 0 with a
+        # zero contribution, which is a no-op add.
+        k_pad = 1 << max(0, int(n_tiles - 1).bit_length())
+        if k_pad > n_tiles:
+            extra_t = k_pad - n_tiles
+            block_super = np.concatenate(
+                [block_super, np.arange(n_tiles, k_pad, dtype=np.int64)]
+            )
+            block_first = np.concatenate(
+                [block_first, np.ones(extra_t, dtype=np.int64)]
+            )
+            c_lo = np.concatenate([c_lo, np.zeros(extra_t, dtype=np.int64)])
+            span = np.concatenate([span, np.zeros(extra_t, dtype=np.int64)])
+            row_pos = np.concatenate(
+                [row_pos, np.full((extra_t * ROWS, LANE), _PAD_ROW, np.int32)]
+            )
+            emeta = np.concatenate(
+                [emeta, np.zeros((extra_t * ROWS, LANE), np.int32)]
+            )
+            n_blocks += extra_t
+            n_tiles = k_pad
 
     if pad_blocks_pow2:
         padded = 1 << max(0, int(n_blocks - 1).bit_length())
         if padded > n_blocks:
             extra = padded - n_blocks
             # Inert blocks: span 0 (no gather), accumulate zeros into the
-            # last supertile (keeps output revisits consecutive).
+            # last (compact) supertile (keeps output revisits consecutive).
             block_super = np.concatenate(
-                [block_super, np.full(extra, n_super - 1, dtype=np.int64)]
+                [block_super, np.full(extra, n_tiles - 1, dtype=np.int64)]
             )
             block_first = np.concatenate(
                 [block_first, np.zeros(extra, dtype=np.int64)]
@@ -211,7 +287,7 @@ def prepare_chunks(
     bmeta1 = (block_super << 1 | block_first).astype(np.int32)
     bmeta2 = (c_lo << _SPAN_BITS | span).astype(np.int32)
 
-    return {
+    prep = {
         "row_pos": row_pos,
         "emeta": emeta,
         "bmeta1": bmeta1,
@@ -222,20 +298,86 @@ def prepare_chunks(
         "n_pad": n_pad,
         "n": n,
         "s_rows": s_rows,
+        "n_pairs": int(m),
     }
+    if compact_supers:
+        k = int(touched.size)
+        super_ids = np.zeros(n_tiles, dtype=np.int32)
+        super_ids[:k] = touched.astype(np.int32)
+        prep["super_ids"] = super_ids
+        prep["out_supers"] = n_tiles
+    if want_slots:
+        prep["slot_ri"] = (
+            slot_ri if slot_ri is not None else np.zeros(0, dtype=np.int64)
+        )
+        prep["slot_col"] = (
+            slot_col if slot_col is not None else np.zeros(0, dtype=np.int64)
+        )
+    return prep
 
 
 def device_args(prep: Dict[str, np.ndarray]) -> tuple:
     """The kernel operands (after flags/recv) in call order."""
-    return (prep["bmeta1"], prep["bmeta2"], prep["row_pos"], prep["emeta"])
+    if "xla_src" in prep:
+        return (prep["xla_src"], prep["xla_dst"])
+    args = (prep["bmeta1"], prep["bmeta2"], prep["row_pos"], prep["emeta"])
+    if "out_supers" in prep:
+        args = args + (prep["super_ids"],)
+    return args
+
+
+def xla_tier(psrc, pdst, n: int, capacity: int) -> Dict[str, np.ndarray]:
+    """A propagation tier held as raw pair arrays, padded to a static
+    ``capacity`` with inert sink pairs (src=dst=n).  Propagated by an
+    XLA scatter-max instead of the Pallas kernel: O(capacity) per
+    fixpoint iteration, but zero pack cost and zero recompiles while
+    the capacity is stable — the landing pad for the newest churn."""
+    m = len(psrc)
+    assert m <= capacity
+    src = np.full(capacity, n, dtype=np.int32)
+    dst = np.full(capacity, n, dtype=np.int32)
+    src[:m] = psrc
+    dst[:m] = pdst
+    return {"xla_src": src, "xla_dst": dst, "capacity": capacity, "n": n}
 
 
 _fn_cache: Dict[tuple, object] = {}
 
 
-def _build_trace_fn(
-    n: int, n_blocks: int, n_super: int, r_rows: int, s_rows: int, interpret: bool
+def layout_spec(prep: Dict[str, np.ndarray]) -> tuple:
+    """The static shape signature of a packed layout (kernel cache key
+    component)."""
+    if "xla_src" in prep:
+        return ("xla", prep["capacity"])
+    if "out_supers" in prep:
+        return ("compact", prep["n_blocks"], prep["out_supers"])
+    return ("dense", prep["n_blocks"])
+
+
+def _build_trace_fn_multi(
+    n: int,
+    specs: tuple,
+    n_super: int,
+    r_rows: int,
+    s_rows: int,
+    interpret: bool,
 ):
+    """Trace fn over one or more pair layouts sharing a node space.
+
+    ``specs`` holds one static shape signature per layout:
+      ("dense", n_blocks)               — full layout, every supertile
+      ("compact", n_blocks, out_tiles)  — only touched supertiles; the
+        kernel output is scattered into the global contribution by the
+        layout's ``super_ids`` operand
+      ("xla", capacity)                 — raw pair arrays propagated by
+        an XLA scatter-max; O(capacity) per iteration but zero pack and
+        zero recompile cost, the landing tier for the newest churn
+
+    Each layout contributes per fixpoint iteration; contributions are
+    combined *before* thresholding, so the result is identical to a
+    single layout holding the union of the pairs.  This is what lets a
+    churning graph keep a big, static "base" layout plus small delta
+    tiers (ops/pallas_incremental) instead of re-packing everything."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -296,30 +438,42 @@ def _build_trace_fn(
         def _():
             out_ref[:] = out_ref[:] + acc
 
-    blockmap = pl.BlockSpec((ROWS, LANE), lambda i, m1, m2: (i, 0))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(n_blocks,),
-        in_specs=[
-            # bit table: whole array, VMEM-resident across all steps
-            pl.BlockSpec((r_rows, LANE), lambda i, m1, m2: (0, 0)),
-            blockmap,  # row_pos
-            blockmap,  # emeta
-        ],
-        out_specs=pl.BlockSpec(
-            (s_rows, LANE), lambda i, m1, m2: (m1[i] >> 1, 0)
-        ),
-    )
-    propagate = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_super * s_rows, LANE), jnp.float32),
-        interpret=interpret,
-    )
+    def make_propagate(n_blocks, out_tiles):
+        blockmap = pl.BlockSpec((ROWS, LANE), lambda i, m1, m2: (i, 0))
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(n_blocks,),
+            in_specs=[
+                # bit table: whole array, VMEM-resident across all steps
+                pl.BlockSpec((r_rows, LANE), lambda i, m1, m2: (0, 0)),
+                blockmap,  # row_pos
+                blockmap,  # emeta
+            ],
+            out_specs=pl.BlockSpec(
+                (s_rows, LANE), lambda i, m1, m2: (m1[i] >> 1, 0)
+            ),
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(
+                (out_tiles * s_rows, LANE), jnp.float32
+            ),
+            interpret=interpret,
+        )
+
+    propagates = []
+    for spec in specs:
+        if spec[0] == "dense":
+            propagates.append(make_propagate(spec[1], n_super))
+        elif spec[0] == "compact":
+            propagates.append(make_propagate(spec[1], spec[2]))
+        else:  # xla tier: no kernel
+            propagates.append(None)
 
     n_words_pad = r_rows * LANE
 
-    def trace_fn(flags, recv_count, bmeta1, bmeta2, row_pos, emeta):
+    def trace_fn(flags, recv_count, *layout_args):
         in_use = (flags & F.FLAG_IN_USE) != 0
         halted = (flags & F.FLAG_HALTED) != 0
         seed = (
@@ -344,11 +498,48 @@ def _build_trace_fn(
             _, changed = carry
             return changed
 
+        sub_iota_rows = jnp.arange(s_rows, dtype=jnp.int32)
+
         def body(carry):
             mark, _ = carry
-            table = pack(mark & (~halted))
-            contrib = propagate(bmeta1, bmeta2, table, row_pos, emeta)
-            hits = contrib.reshape(-1)[:n] > 0
+            active = mark & (~halted)
+            table = pack(active)
+            contrib = jnp.zeros((n_super * s_rows, LANE), jnp.float32)
+            xla_hits = jnp.zeros((n,), bool)
+            pos = 0
+            for idx, (spec, propagate) in enumerate(zip(specs, propagates)):
+                if spec[0] == "xla":
+                    psrc, pdst = layout_args[pos : pos + 2]
+                    pos += 2
+                    active_pad = jnp.concatenate(
+                        [active, jnp.zeros((1,), bool)]
+                    )
+                    src_active = active_pad[psrc]
+                    prop = (
+                        jnp.zeros((n + 1,), jnp.int32)
+                        .at[pdst]
+                        .max(src_active.astype(jnp.int32))
+                    )
+                    xla_hits = xla_hits | (prop[:n] > 0)
+                    continue
+                if spec[0] == "compact":
+                    bmeta1, bmeta2, row_pos, emeta, super_ids = layout_args[
+                        pos : pos + 5
+                    ]
+                    pos += 5
+                    c = propagate(bmeta1, bmeta2, table, row_pos, emeta)
+                    rows = (
+                        super_ids[:, None] * s_rows + sub_iota_rows[None, :]
+                    ).reshape(-1)
+                    contrib = contrib.at[rows].add(
+                        c, mode="drop", unique_indices=False
+                    )
+                else:
+                    bmeta1, bmeta2, row_pos, emeta = layout_args[pos : pos + 4]
+                    pos += 4
+                    c = propagate(bmeta1, bmeta2, table, row_pos, emeta)
+                    contrib = contrib + c
+            hits = (contrib.reshape(-1)[:n] > 0) | xla_hits
             new_mark = mark | (hits & in_use)
             changed = jnp.any(new_mark != mark)
             return new_mark, changed
@@ -359,31 +550,45 @@ def _build_trace_fn(
     return jax.jit(trace_fn)
 
 
-def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
-    """Cached jitted trace fn for a prepared pair-array layout.
-
-    ``interpret`` defaults to True off-TPU (Mosaic can't compile there)."""
+def default_interpret() -> bool:
+    """Interpret mode defaults to True off-TPU (Mosaic can't compile
+    there).  The "axon" platform is a TPU tunnel plugin — a real chip —
+    so it compiles for real."""
     import jax
 
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    key = (
+    return jax.devices()[0].platform not in ("tpu", "axon")
+
+
+def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
+    """Cached jitted trace fn for a prepared pair-array layout."""
+    return get_trace_fn_multi(
         prep["n"],
-        prep["n_blocks"],
+        (layout_spec(prep),),
         prep["n_super"],
         prep["r_rows"],
         prep["s_rows"],
         interpret,
     )
+
+
+def get_trace_fn_multi(
+    n: int,
+    specs: tuple,
+    n_super: int,
+    r_rows: int,
+    s_rows: int,
+    interpret: bool | None = None,
+):
+    """Cached jitted trace fn over one or more pair layouts (operand
+    arrays per layout in ``device_args`` order, appended after
+    flags/recv)."""
+    if interpret is None:
+        interpret = default_interpret()
+    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret)
     fn = _fn_cache.get(key)
     if fn is None:
-        fn = _build_trace_fn(
-            prep["n"],
-            prep["n_blocks"],
-            prep["n_super"],
-            prep["r_rows"],
-            prep["s_rows"],
-            interpret,
+        fn = _build_trace_fn_multi(
+            n, tuple(specs), n_super, r_rows, s_rows, interpret
         )
         _fn_cache[key] = fn
     return fn
@@ -391,9 +596,39 @@ def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
 
 def trace_marks_prepared(flags, recv_count, prep: Dict[str, np.ndarray]) -> np.ndarray:
     """Run the Pallas-backed trace against pre-packed pair arrays."""
-    n = prep["n"]
-    fn = get_trace_fn(prep)
-    out = fn(flags[:n], recv_count[:n], *device_args(prep))
+    return trace_marks_layouts(flags, recv_count, [prep])
+
+
+def trace_marks_layouts(
+    flags, recv_count, preps, interpret: bool | None = None
+) -> np.ndarray:
+    """Run the Pallas-backed trace against one or more pair layouts that
+    share a node space (their per-node contributions are combined before
+    thresholding, so the union of the layouts' pairs propagates).  The
+    first layout must be a packed (non-xla) one; it pins the geometry."""
+    first = preps[0]
+    n = first["n"]
+    assert "xla_src" not in first, "first layout pins the packed geometry"
+    for p in preps[1:]:
+        assert p["n"] == n, "layouts must share the node space"
+        if "xla_src" not in p:
+            assert (
+                p["n_super"] == first["n_super"]
+                and p["r_rows"] == first["r_rows"]
+                and p["s_rows"] == first["s_rows"]
+            ), "layouts must share node-space geometry"
+    fn = get_trace_fn_multi(
+        n,
+        tuple(layout_spec(p) for p in preps),
+        first["n_super"],
+        first["r_rows"],
+        first["s_rows"],
+        interpret,
+    )
+    args = []
+    for p in preps:
+        args.extend(device_args(p))
+    out = fn(flags[:n], recv_count[:n], *args)
     return np.asarray(out)
 
 
