@@ -25,7 +25,7 @@ BASE = {
 
 from conftest import NATIVE_BACKEND
 
-BACKENDS = ["array", NATIVE_BACKEND]
+BACKENDS = ["array", "mesh", NATIVE_BACKEND]
 
 
 def make_system(name, fabric, num_nodes, backend="array"):

@@ -149,6 +149,10 @@ class Sim:
             from uigc_tpu.native import NativeShadowGraph
 
             self.array = NativeShadowGraph(self.context, self.system.address)
+        elif backend == "mesh":
+            from uigc_tpu.engines.crgc.mesh import MeshShadowGraph
+
+            self.array = MeshShadowGraph(self.context, self.system.address)
         else:
             self.array = ArrayShadowGraph(
                 self.context, self.system.address, use_device=(backend == "device")
@@ -249,7 +253,7 @@ class Sim:
 from conftest import NATIVE_AVAILABLE, NATIVE_BACKEND
 
 
-@pytest.mark.parametrize("backend", ["array", "device", NATIVE_BACKEND])
+@pytest.mark.parametrize("backend", ["array", "device", "mesh", NATIVE_BACKEND])
 @pytest.mark.parametrize("seed", [7, 42, 20260729])
 def test_random_protocol_parity(seed, backend):
     sim = Sim(seed, backend=backend)

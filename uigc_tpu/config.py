@@ -39,7 +39,12 @@ DEFAULTS: Dict[str, Any] = {
     #   "array"  - dense-array graph folded on host (numpy)
     #   "device" - dense-array graph with the trace run on the TPU via JAX
     #   "native" - C++ data plane (uigc_tpu/native/), batch fold + trace
+    #   "mesh"   - fold/trace state sharded across a jax device mesh
+    #              (engines/crgc/mesh.py); per-wake deltas stream to the
+    #              devices, the trace all_gathers marks over ICI
     "uigc.crgc.shadow-graph": "array",
+    # Devices in the mesh backend's mesh; 0 = all visible devices.
+    "uigc.crgc.mesh-devices": 0,
     # --- MAC engine settings (reference: reference.conf:43-50) ---
     "uigc.mac.cycle-detection": False,
     # Milliseconds between cycle-detector wakeups (reference:

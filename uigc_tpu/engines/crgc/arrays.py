@@ -86,6 +86,10 @@ class ArrayShadowGraph:
         self._pair_log: Optional[List[tuple]] = []
         self._log_cap = 1 << 20
         self._inc = None  # lazily-built IncrementalPallasLayout
+        #: slots whose flags/recv changed since last consumed; enabled
+        #: (non-None) by backends that mirror node features elsewhere
+        #: (the mesh backend's sharded device arrays)
+        self._node_log: Optional[Set[int]] = None
 
     # ------------------------------------------------------------- #
     # Capacity management (static-shape friendly: powers of two)
@@ -143,7 +147,12 @@ class ArrayShadowGraph:
         self.flags[slot] = _F.FLAG_IN_USE  # not interned, not local
         self.recv_count[slot] = 0
         self.supervisor[slot] = -1
+        self._touch(slot)
         return slot
+
+    def _touch(self, slot: int) -> None:
+        if self._node_log is not None:
+            self._node_log.add(slot)
 
     def _log_pair(self, insert: bool, src: int, dst: int, kind: int) -> None:
         """Record a live-pair transition for the incremental Pallas
@@ -227,6 +236,7 @@ class ArrayShadowGraph:
             flags[self_slot] |= _F.FLAG_ROOT
         else:
             flags[self_slot] &= ~_F.FLAG_ROOT
+        self._touch(self_slot)
 
         field_size = self.context.entry_field_size
 
@@ -254,6 +264,7 @@ class ArrayShadowGraph:
             send_count = refob_info.count(info)
             if send_count > 0:
                 self.recv_count[target_slot] -= send_count
+                self._touch(target_slot)
             if not refob_info.is_active(info):
                 self._update_edge(self_slot, target_slot, -1)
 
@@ -275,6 +286,7 @@ class ArrayShadowGraph:
                 else:
                     self.flags[slot] &= ~_F.FLAG_ROOT
             self.recv_count[slot] += delta_shadow.recv_count
+            self._touch(slot)
             if delta_shadow.supervisor >= 0:
                 self._set_supervisor(slot, slots[delta_shadow.supervisor])
             for target_id, count in delta_shadow.outgoing.items():
@@ -298,9 +310,11 @@ class ArrayShadowGraph:
             slot = self.slot_of[cell]
             if self.locations[slot] == log.node_address:
                 self.flags[slot] |= _F.FLAG_HALTED
+                self._touch(slot)
             field = log.admitted.get(cell)
             if field is not None:
                 self.recv_count[slot] += field.message_count
+                self._touch(slot)
                 for target_cell, count in field.created_refs.items():
                     if target_cell not in seen:
                         seen.add(target_cell)
@@ -409,6 +423,7 @@ class ArrayShadowGraph:
         self.locations[slot] = None
         self.flags[slot] = 0
         self.recv_count[slot] = 0
+        self._touch(slot)
         self._set_supervisor(slot, -1)
         for eid in list(self.out_edges[slot]):
             self._free_edge(eid)

@@ -1,0 +1,300 @@
+"""Mesh shadow-graph backend: the collector's data plane sharded over a
+TPU device mesh.
+
+This is the node-level sharding capability of the reference
+(LocalGC.scala:191-196 replicates per-node graphs via DeltaGraph gossip)
+re-expressed the TPU way, per SURVEY §7: instead of replicating the graph
+per host, the detection state is *partitioned* across the devices of one
+slice —
+
+- node feature arrays (flags, recv_count) live device-resident, sharded
+  by contiguous slot range over the mesh axis;
+- propagation pairs (positive refob edges + supervisor pointers) live
+  device-resident as per-destination-shard buckets, so each device's
+  scatter lands only in its own node shard;
+- each trace wave all_gathers the mark vector over ICI (the collective
+  analogue of the DeltaMsg broadcast) and decides convergence with a
+  global psum (parallel/sharded_trace.py).
+
+The host keeps its mirror (interning, edge dict, sweep bookkeeping) and
+streams *only the per-wake changes* to the device: dirty node rows
+(``_node_log``) and pair transitions (``_pair_log``) are scatter-applied
+with donated buffers, so steady-state host->device traffic is O(churn),
+not O(graph).  Full rebuilds happen only on capacity growth or log
+overflow.
+
+Composes with the multi-node path: a cluster of collectors can each run
+a mesh graph and still gossip DeltaGraphs/undo logs between hosts — the
+mesh shards one node's replica, the fabric replicates across nodes (the
+two levels the reference collapses into one).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...ops import trace as trace_ops
+from ...parallel import sharded_trace
+from ...utils import events
+from .arrays import ArrayShadowGraph
+from .state import CrgcContext
+
+_SINK_PAD = 64  # scatter batches are padded to multiples of this
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(0, int(x - 1).bit_length())
+
+
+class MeshShadowGraph(ArrayShadowGraph):
+    """Shadow graph whose fold/trace state is sharded across a device
+    mesh; liveness semantics identical to the host oracle (differential
+    tests drive both over the same entry streams)."""
+
+    def __init__(
+        self,
+        context: CrgcContext,
+        local_address: Optional[str] = None,
+        n_devices: int = 0,
+        initial_capacity: int = 1024,
+    ):
+        super().__init__(
+            context,
+            local_address,
+            use_device=True,
+            initial_capacity=initial_capacity,
+        )
+        import jax
+
+        avail = len(jax.devices())
+        if n_devices <= 0:
+            n_devices = avail
+        # A mesh bigger than the host would silently mis-shard: build_mesh
+        # slices jax.devices()[:n] while bucket geometry keeps n, leaving
+        # pair_dst offsets relative to the wrong shard origin.
+        assert n_devices <= avail, (
+            f"uigc.crgc.mesh-devices={n_devices} but only {avail} devices"
+        )
+        self.n_devices = n_devices
+        self.mesh = sharded_trace.build_mesh(n_devices)
+        self._trace_fn = sharded_trace.make_sharded_trace(self.mesh)
+        self._node_log = set()  # enable dirty-slot tracking in the base
+
+        # device state (built lazily on first trace)
+        self._dev_ready = False
+        self._dev_flags = None
+        self._dev_recv = None
+        self._dev_psrc = None
+        self._dev_pdst = None
+        self._n_pad = 0
+        self._shard_size = 0
+        # host mirror of the pair buckets
+        self._bucket_m = 0  # columns per shard (pow2)
+        self._pb_src: Optional[np.ndarray] = None  # [D, M] global src ids
+        self._pb_dst: Optional[np.ndarray] = None  # [D, M] local dst ids
+        self._pb_count: Optional[np.ndarray] = None
+        self._pb_free: List[List[int]] = []
+        #: (src, dst, kind) -> (shard, column)
+        self._pb_slot: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+        self.stats = {"rebuilds": 0, "wakes": 0, "anomalies": 0}
+
+        self._jit_cache: Dict[str, object] = {}
+
+    # ------------------------------------------------------------- #
+    # Device state construction
+    # ------------------------------------------------------------- #
+
+    def _sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return (
+            NamedSharding(self.mesh, P("gc")),
+            NamedSharding(self.mesh, P("gc", None)),
+        )
+
+    def _full_rebuild(self) -> None:
+        import jax
+
+        self.stats["rebuilds"] += 1
+        D = self.n_devices
+        n_pad = ((self.capacity + D - 1) // D) * D
+        self._n_pad = n_pad
+        self._shard_size = n_pad // D
+
+        # --- pair buckets from the host truth --------------------- #
+        from ...ops.pallas_incremental import IncrementalPallasLayout
+
+        esrc, edst, kinds = IncrementalPallasLayout.pairs_from_graph(
+            self.edge_src, self.edge_dst, self.edge_weight, self.supervisor
+        )
+
+        owner = edst // self._shard_size
+        order = np.argsort(owner, kind="stable")
+        esrc, edst, kinds, owner = (
+            esrc[order],
+            edst[order],
+            kinds[order],
+            owner[order],
+        )
+        counts = np.bincount(owner, minlength=D).astype(np.int64)
+        # 2x headroom so a bucket overflow doesn't rebuild into an
+        # already-full layout (rebuild storm)
+        m = _pow2(max(1024, 2 * int(counts.max(initial=0))))
+        self._bucket_m = m
+        self._pb_src = np.full((D, m), self._n_pad, dtype=np.int32)
+        self._pb_dst = np.zeros((D, m), dtype=np.int32)
+        self._pb_count = counts
+        self._pb_free = [[] for _ in range(D)]
+        # column of each (sorted) pair within its shard
+        starts = np.zeros(D, dtype=np.int64)
+        starts[1:] = np.cumsum(counts)[:-1]
+        col = np.arange(esrc.size, dtype=np.int64) - starts[owner]
+        self._pb_src[owner, col] = esrc
+        self._pb_dst[owner, col] = edst - owner * self._shard_size
+        self._pb_slot = {
+            (int(s), int(d), int(k)): (int(sh), int(c))
+            for s, d, k, sh, c in zip(esrc, edst, kinds, owner, col)
+        }
+
+        # --- device arrays ---------------------------------------- #
+        nodes_s, pairs_s = self._sharding()
+        flags = np.zeros(n_pad, dtype=np.uint8)
+        flags[: self.capacity] = self.flags
+        recv = np.zeros(n_pad, dtype=np.int64)
+        recv[: self.capacity] = self.recv_count
+        self._dev_flags = jax.device_put(flags, nodes_s)
+        self._dev_recv = jax.device_put(recv, nodes_s)
+        self._dev_psrc = jax.device_put(self._pb_src, pairs_s)
+        self._dev_pdst = jax.device_put(self._pb_dst, pairs_s)
+
+        self._pair_log = []
+        self._node_log = set()
+        self._dev_ready = True
+
+    # ------------------------------------------------------------- #
+    # Incremental device sync (O(churn) per wake)
+    # ------------------------------------------------------------- #
+
+    def _apply_pair_log(self) -> Optional[list]:
+        """Fold pair transitions into the host buckets; returns the
+        device scatter batch, or None if the buckets overflowed (full
+        rebuild required)."""
+        writes: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        for insert, src, dst, kind in self._pair_log:
+            key = (src, dst, kind)
+            if insert:
+                if key in self._pb_slot:
+                    self.stats["anomalies"] += 1
+                    continue
+                shard = dst // self._shard_size
+                free = self._pb_free[shard]
+                if free:
+                    colm = free.pop()
+                else:
+                    colm = int(self._pb_count[shard])
+                    if colm >= self._bucket_m:
+                        return None  # bucket overflow
+                    self._pb_count[shard] = colm + 1
+                self._pb_slot[key] = (shard, colm)
+                self._pb_src[shard, colm] = src
+                local = dst - shard * self._shard_size
+                self._pb_dst[shard, colm] = local
+                writes[(shard, colm)] = (src, local)
+            else:
+                slot = self._pb_slot.pop(key, None)
+                if slot is None:
+                    self.stats["anomalies"] += 1
+                    continue
+                shard, colm = slot
+                self._pb_src[shard, colm] = self._n_pad  # sink
+                self._pb_dst[shard, colm] = 0
+                self._pb_free[shard].append(colm)
+                writes[(shard, colm)] = (self._n_pad, 0)
+        self._pair_log = []
+        return list(writes.items())
+
+    def _jit(self, name, builder):
+        fn = self._jit_cache.get(name)
+        if fn is None:
+            fn = self._jit_cache[name] = builder()
+        return fn
+
+    def _sync_device(self) -> None:
+        if (
+            not self._dev_ready
+            or self._pair_log is None
+            or self._n_pad < self.capacity
+        ):
+            self._full_rebuild()
+            return
+        pair_writes = self._apply_pair_log() if self._pair_log else []
+        if pair_writes is None:
+            self._full_rebuild()
+            return
+        import jax
+        import jax.numpy as jnp
+
+        if pair_writes:
+            k = len(pair_writes)
+            kp = max(_SINK_PAD, _pow2(k))
+            shs = np.full(kp, self.n_devices, dtype=np.int32)  # OOB -> drop
+            cols = np.zeros(kp, dtype=np.int32)
+            srcs = np.zeros(kp, dtype=np.int32)
+            dsts = np.zeros(kp, dtype=np.int32)
+            for i, ((sh, colm), (s, d)) in enumerate(pair_writes):
+                shs[i], cols[i], srcs[i], dsts[i] = sh, colm, s, d
+
+            def build_pairs():
+                @partial(jax.jit, donate_argnums=(0, 1))
+                def apply_pairs(psrc, pdst, shs, cols, srcs, dsts):
+                    psrc = psrc.at[shs, cols].set(srcs, mode="drop")
+                    pdst = pdst.at[shs, cols].set(dsts, mode="drop")
+                    return psrc, pdst
+
+                return apply_pairs
+
+            self._dev_psrc, self._dev_pdst = self._jit("pairs", build_pairs)(
+                self._dev_psrc, self._dev_pdst, shs, cols, srcs, dsts
+            )
+
+        if self._node_log:
+            slots_list = list(self._node_log)
+            self._node_log = set()
+            k = len(slots_list)
+            kp = max(_SINK_PAD, _pow2(k))
+            slots = np.full(kp, self._n_pad, dtype=np.int32)  # OOB -> drop
+            slots[:k] = slots_list
+            fvals = np.zeros(kp, dtype=np.uint8)
+            rvals = np.zeros(kp, dtype=np.int64)
+            fvals[:k] = self.flags[slots_list]
+            rvals[:k] = self.recv_count[slots_list]
+
+            def build_nodes():
+                @partial(jax.jit, donate_argnums=(0, 1))
+                def apply_nodes(flags, recv, slots, fvals, rvals):
+                    flags = flags.at[slots].set(fvals, mode="drop")
+                    recv = recv.at[slots].set(rvals, mode="drop")
+                    return flags, recv
+
+                return apply_nodes
+
+            self._dev_flags, self._dev_recv = self._jit("nodes", build_nodes)(
+                self._dev_flags, self._dev_recv, slots, fvals, rvals
+            )
+
+    # ------------------------------------------------------------- #
+    # Trace
+    # ------------------------------------------------------------- #
+
+    def compute_marks(self) -> np.ndarray:
+        with events.recorder.timed(events.DEVICE_TRACE):
+            self._sync_device()
+            self.stats["wakes"] += 1
+            mark = self._trace_fn(
+                self._dev_flags, self._dev_recv, self._dev_psrc, self._dev_pdst
+            )
+            return np.asarray(mark)[: self.capacity]

@@ -86,7 +86,10 @@ class IncrementalPallasLayout:
         self.frozen_slot: Dict[Key, Tuple[int, int, int]] = {}
         #: newest insertions, not yet packed (ordered set)
         self.pending: Dict[Key, None] = {}
-        self.masked = 0
+        #: masked (deleted-in-place) slots, tracked per home so frozen
+        #: masks can be forgiven when consolidation rebuilds the chain
+        self.masked_base = 0
+        self.masked_frozen = 0
         self._xla_cap = 1 << 10
         self.stats = {
             "rebuilds": 0,
@@ -137,7 +140,8 @@ class IncrementalPallasLayout:
         self.frozen = []
         self.frozen_slot = {}
         self.pending.clear()
-        self.masked = 0
+        self.masked_base = 0
+        self.masked_frozen = 0
         self.stats["rebuilds"] += 1
         self.stats["pack_s"] += perf_counter() - t0
 
@@ -174,6 +178,7 @@ class IncrementalPallasLayout:
         m = len(keys)
         if m == 0:
             self.frozen = []
+            self.masked_frozen = 0
             self.stats["consolidations"] += 1
             return
         psrc = np.fromiter((k[0] for k in keys), np.int64, m)
@@ -194,6 +199,8 @@ class IncrementalPallasLayout:
             key: (0, int(ri), int(co))
             for key, ri, co in zip(keys, slot_ri, slot_col)
         }
+        # consolidation dropped every masked frozen slot
+        self.masked_frozen = 0
         self.stats["consolidations"] += 1
         self.stats["pack_s"] += perf_counter() - t0
 
@@ -222,7 +229,7 @@ class IncrementalPallasLayout:
             prep = self.frozen[fidx]
             prep["row_pos"][ri, col] = pt._PAD_ROW
             prep["emeta"][ri, col] = 0
-            self.masked += 1
+            self.masked_frozen += 1
             return
         slot = self.base_slot.pop(key, None)
         if slot is None:
@@ -231,11 +238,16 @@ class IncrementalPallasLayout:
         ri, col = slot
         self.base["row_pos"][ri, col] = pt._PAD_ROW
         self.base["emeta"][ri, col] = 0
-        self.masked += 1
+        self.masked_base += 1
 
     @property
     def churn(self) -> int:
-        return len(self.frozen_slot) + len(self.pending) + self.masked
+        return (
+            len(self.frozen_slot)
+            + len(self.pending)
+            + self.masked_base
+            + self.masked_frozen
+        )
 
     @property
     def needs_repack(self) -> bool:
