@@ -115,6 +115,11 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.config != "powerlaw":
+        # The live configs run the host actor runtime, but a device
+        # shadow-graph backend (or any jax import inside the workload)
+        # would still hit the flaky TPU init — give them the same probe
+        # protection as the device path.
+        probe_platform()
         run_live_config(args)
         return
 
@@ -147,7 +152,27 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
-        platform = jax.devices()[0].platform
+        try:
+            platform = jax.devices()[0].platform
+        except Exception as exc:
+            # jax can cache a fatal backend-init error; even forced-CPU
+            # init then re-raises.  Emit a degraded result line rather
+            # than dying without the JSON contract line.
+            print(
+                json.dumps(
+                    {
+                        "metric": "garbage_actors_per_sec",
+                        "value": 0.0,
+                        "unit": "actors/s",
+                        "vs_baseline": 0.0,
+                        "platform": "none",
+                        "platform_degraded": True,
+                        "probe": probe["probe"] + f"; cpu fallback failed: {str(exc)[:200]}",
+                        "error": "jax backend unavailable on every platform",
+                    }
+                )
+            )
+            return
     # "axon" is the TPU tunnel plugin: a real chip behind a relay.
     is_tpu = platform in ("tpu", "axon")
     if args.n is None:

@@ -55,17 +55,28 @@ def main():
     rebuild_s = layout.stats["pack_s"]
 
     live = np.nonzero(w > 0)[0]
+    seen_inserts = set()
     inc_times = []
     for _ in range(args.wakes):
-        # half deletes of existing live edges, half fresh inserts
+        # Half deletes of existing live edges, half fresh inserts.  Kill
+        # candidates are removed from the live pool so a later wake never
+        # re-deletes the same edge (which would hit the layout's anomaly
+        # path instead of doing real deletion work); inserts are deduped
+        # for the same reason.
         kill = rng.choice(live, size=args.churn // 2, replace=False)
+        live = np.setdiff1d(live, kill, assume_unique=True)
+        fresh = []
+        while len(fresh) < args.churn // 2:
+            pair = (int(rng.integers(0, args.n)), int(rng.integers(0, args.n)))
+            if pair not in seen_inserts:
+                seen_inserts.add(pair)
+                fresh.append(pair)
+        log = [(False, int(src[eid]), int(dst[eid]), pinc.EDGE) for eid in kill]
+        log += [(True, s, d, pinc.EDGE) for s, d in fresh]
         t0 = time.perf_counter()
-        for eid in kill:
-            layout.remove(int(src[eid]), int(dst[eid]), pinc.EDGE)
-        for _i in range(args.churn // 2):
-            layout.insert(
-                int(rng.integers(0, args.n)), int(rng.integers(0, args.n)), pinc.EDGE
-            )
+        # the production path: batched log replay (arrays.py feeds the
+        # collector's _pair_log through apply_log the same way)
+        layout.apply_log(log)
         # everything trace() does on the host except the kernel launch
         layout.prepare_wake()
         inc_times.append(time.perf_counter() - t0)
@@ -81,6 +92,7 @@ def main():
             statistics.median(full_times) / statistics.median(inc_times), 1
         ),
         "one_time_rebuild_ms": round(rebuild_s * 1e3, 2),
+        "anomalies": layout.stats["anomalies"],
     }
     print(json.dumps(result))
 

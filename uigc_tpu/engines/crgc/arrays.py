@@ -81,9 +81,13 @@ class ArrayShadowGraph:
         self.in_edges: List[Set[int]] = [set() for _ in range(cap)]
 
         #: changelog of pair transitions since the Pallas layout last
-        #: consumed it: (insert?, src, dst, kind).  ``None`` = too much
-        #: churn (or geometry change) — do a full repack instead.
-        self._pair_log: Optional[List[tuple]] = []
+        #: consumed it: (insert?, src, dst, kind).  ``None`` means either
+        #: "no consumer yet" or "too much churn / geometry change" — the
+        #: consumer does a full rebuild (which re-enables the log).  Off
+        #: by default so backends that never consume it (host array, the
+        #: XLA trace off-TPU) pay one None check per mutation instead of
+        #: accumulating up to ``_log_cap`` dead tuples.
+        self._pair_log: Optional[List[tuple]] = None
         self._log_cap = 1 << 20
         self._inc = None  # lazily-built IncrementalPallasLayout
         #: slots whose flags/recv changed since last consumed; enabled
@@ -380,11 +384,7 @@ class ArrayShadowGraph:
             )
             self._pair_log = []
         elif self._pair_log:
-            for insert, src, dst, kind in self._pair_log:
-                if insert:
-                    inc.insert(src, dst, kind)
-                else:
-                    inc.remove(src, dst, kind)
+            inc.apply_log(self._pair_log)
             self._pair_log.clear()
             if inc.needs_repack:
                 inc.rebuild(

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ...ops import trace as trace_ops
+from ...ops.slotmap import PackedSlotMap, fold_log, pack_keys, unpack_keys
 from ...parallel import sharded_trace
 from ...utils import events
 from .arrays import ArrayShadowGraph
@@ -97,8 +98,10 @@ class MeshShadowGraph(ArrayShadowGraph):
         self._pb_dst: Optional[np.ndarray] = None  # [D, M] local dst ids
         self._pb_count: Optional[np.ndarray] = None
         self._pb_free: List[List[int]] = []
-        #: (src, dst, kind) -> (shard, column)
-        self._pb_slot: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
+        #: packed (src, dst, kind) key -> packed (shard << 32 | column);
+        #: sorted numpy bulk + churn overlays (ops/slotmap.py) so rebuild
+        #: stays vectorized instead of one Python dict entry per pair
+        self._pb_slot = PackedSlotMap()
         self.stats = {"rebuilds": 0, "wakes": 0, "anomalies": 0}
 
         self._jit_cache: Dict[str, object] = {}
@@ -155,10 +158,10 @@ class MeshShadowGraph(ArrayShadowGraph):
         col = np.arange(esrc.size, dtype=np.int64) - starts[owner]
         self._pb_src[owner, col] = esrc
         self._pb_dst[owner, col] = edst - owner * self._shard_size
-        self._pb_slot = {
-            (int(s), int(d), int(k)): (int(sh), int(c))
-            for s, d, k, sh, c in zip(esrc, edst, kinds, owner, col)
-        }
+        self._pb_slot = PackedSlotMap(
+            pack_keys(esrc, edst, kinds),
+            (owner.astype(np.int64) << 32) | col,
+        )
 
         # --- device arrays ---------------------------------------- #
         nodes_s, pairs_s = self._sharding()
@@ -182,12 +185,44 @@ class MeshShadowGraph(ArrayShadowGraph):
     def _apply_pair_log(self) -> Optional[list]:
         """Fold pair transitions into the host buckets; returns the
         device scatter batch, or None if the buckets overflowed (full
-        rebuild required)."""
+        rebuild required).
+
+        Batched like IncrementalPallasLayout.apply_log (the net-effect
+        argument and anomaly accounting live in slotmap.fold_log): slot
+        lookups are one vectorized binary search per batch."""
+        removes, cond_removes, inserts = fold_log(self._pair_log)
         writes: Dict[Tuple[int, int], Tuple[int, int]] = {}
-        for insert, src, dst, kind in self._pair_log:
-            key = (src, dst, kind)
-            if insert:
-                if key in self._pb_slot:
+
+        def free_slot_batch(keys: list, found_is_anomaly: bool) -> None:
+            vals = self._pb_slot.pop_batch(np.fromiter(keys, np.int64, len(keys)))
+            for packed in vals.tolist():
+                if packed < 0:
+                    if not found_is_anomaly:
+                        self.stats["anomalies"] += 1
+                    continue
+                if found_is_anomaly:
+                    self.stats["anomalies"] += 1
+                shard, colm = packed >> 32, packed & 0xFFFFFFFF
+                self._pb_src[shard, colm] = self._n_pad  # sink
+                self._pb_dst[shard, colm] = 0
+                self._pb_free[shard].append(colm)
+                writes[(shard, colm)] = (self._n_pad, 0)
+
+        if removes:
+            free_slot_batch(removes, found_is_anomaly=False)
+        if cond_removes:
+            # insert-first/remove-last: net no-op unless the key was
+            # already live (anomalous duplicate insert + real remove).
+            free_slot_batch(cond_removes, found_is_anomaly=True)
+
+        if inserts:
+            karr = np.fromiter(inserts, np.int64, len(inserts))
+            present = self._pb_slot.get_batch(karr) >= 0
+            srcs, dsts = unpack_keys(karr)
+            for key, src, dst, dup in zip(
+                inserts, srcs.tolist(), dsts.tolist(), present.tolist()
+            ):
+                if dup:
                     self.stats["anomalies"] += 1
                     continue
                 shard = dst // self._shard_size
@@ -199,21 +234,11 @@ class MeshShadowGraph(ArrayShadowGraph):
                     if colm >= self._bucket_m:
                         return None  # bucket overflow
                     self._pb_count[shard] = colm + 1
-                self._pb_slot[key] = (shard, colm)
+                self._pb_slot.add(key, (shard << 32) | colm)
                 self._pb_src[shard, colm] = src
                 local = dst - shard * self._shard_size
                 self._pb_dst[shard, colm] = local
                 writes[(shard, colm)] = (src, local)
-            else:
-                slot = self._pb_slot.pop(key, None)
-                if slot is None:
-                    self.stats["anomalies"] += 1
-                    continue
-                shard, colm = slot
-                self._pb_src[shard, colm] = self._n_pad  # sink
-                self._pb_dst[shard, colm] = 0
-                self._pb_free[shard].append(colm)
-                writes[(shard, colm)] = (self._n_pad, 0)
         self._pair_log = []
         return list(writes.items())
 

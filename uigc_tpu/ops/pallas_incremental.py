@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import pallas_trace as pt
+from .slotmap import PackedSlotMap, fold_log, pack_key, pack_keys, unpack_keys
 
 #: pair kinds
 EDGE = 0
@@ -78,14 +79,16 @@ class IncrementalPallasLayout:
         self.max_frozen = max_frozen
         self.interpret = interpret
         self.base: Optional[Dict[str, np.ndarray]] = None
-        #: (src, dst, kind) -> (row, col) into the base row_pos/emeta
-        self.base_slot: Dict[Key, Tuple[int, int]] = {}
+        #: packed (src, dst, kind) key -> packed (row << 8 | col) into the
+        #: base row_pos/emeta.  Sorted numpy bulk + churn overlays, so a
+        #: rebuild stays vectorized and O(E) ints, not O(E) Python objects.
+        self.base_slot = PackedSlotMap()
         #: frozen compact delta layouts
         self.frozen: List[Dict[str, np.ndarray]] = []
-        #: key -> (frozen index, row, col)
-        self.frozen_slot: Dict[Key, Tuple[int, int, int]] = {}
-        #: newest insertions, not yet packed (ordered set)
-        self.pending: Dict[Key, None] = {}
+        #: packed key -> (frozen index, row, col); churn-bounded, plain dict
+        self.frozen_slot: Dict[int, Tuple[int, int, int]] = {}
+        #: newest insertions, not yet packed (ordered set of packed keys)
+        self.pending: Dict[int, None] = {}
         #: masked (deleted-in-place) slots, tracked per home so frozen
         #: masks can be forgiven when consolidation rebuilds the chain
         self.masked_base = 0
@@ -133,10 +136,9 @@ class IncrementalPallasLayout:
         )
         slot_ri = self.base.pop("slot_ri")
         slot_col = self.base.pop("slot_col")
-        self.base_slot = {
-            (int(s), int(d), int(k)): (int(ri), int(co))
-            for s, d, k, ri, co in zip(psrc, pdst, kinds, slot_ri, slot_col)
-        }
+        self.base_slot = PackedSlotMap(
+            pack_keys(psrc, pdst, kinds), (slot_ri << 8) | slot_col
+        )
         self.frozen = []
         self.frozen_slot = {}
         self.pending.clear()
@@ -150,8 +152,7 @@ class IncrementalPallasLayout:
         t0 = perf_counter()
         keys = list(self.pending)
         m = len(keys)
-        psrc = np.fromiter((k[0] for k in keys), np.int64, m)
-        pdst = np.fromiter((k[1] for k in keys), np.int64, m)
+        psrc, pdst = unpack_keys(np.fromiter(keys, np.int64, m))
         prep = pt.prepare_pairs(
             psrc,
             pdst,
@@ -181,8 +182,7 @@ class IncrementalPallasLayout:
             self.masked_frozen = 0
             self.stats["consolidations"] += 1
             return
-        psrc = np.fromiter((k[0] for k in keys), np.int64, m)
-        pdst = np.fromiter((k[1] for k in keys), np.int64, m)
+        psrc, pdst = unpack_keys(np.fromiter(keys, np.int64, m))
         prep = pt.prepare_pairs(
             psrc,
             pdst,
@@ -209,8 +209,8 @@ class IncrementalPallasLayout:
     # ----------------------------------------------------------------- #
 
     def insert(self, src: int, dst: int, kind: int) -> None:
-        key = (src, dst, kind)
-        if key in self.base_slot or key in self.frozen_slot or key in self.pending:
+        key = pack_key(src, dst, kind)
+        if key in self.pending or key in self.frozen_slot or key in self.base_slot:
             # The graph layer only reports dead->live transitions, so a
             # duplicate means caller-side accounting drift; the pair is
             # already live here, which keeps the trace correct.
@@ -219,7 +219,7 @@ class IncrementalPallasLayout:
         self.pending[key] = None
 
     def remove(self, src: int, dst: int, kind: int) -> None:
-        key = (src, dst, kind)
+        key = pack_key(src, dst, kind)
         if key in self.pending:
             del self.pending[key]
             return
@@ -231,14 +231,98 @@ class IncrementalPallasLayout:
             prep["emeta"][ri, col] = 0
             self.masked_frozen += 1
             return
-        slot = self.base_slot.pop(key, None)
-        if slot is None:
+        packed = self.base_slot.pop(key)
+        if packed is None:
             self.stats["anomalies"] += 1
             return
-        ri, col = slot
+        ri, col = packed >> 8, packed & 0xFF
         self.base["row_pos"][ri, col] = pt._PAD_ROW
         self.base["emeta"][ri, col] = 0
         self.masked_base += 1
+
+    def _mask_base_slots(self, vals: np.ndarray) -> int:
+        """Mask base slots from packed (row << 8 | col) values (-1 =
+        absent); returns how many were found."""
+        found = vals >= 0
+        ri = vals[found] >> 8
+        col = vals[found] & 0xFF
+        self.base["row_pos"][ri, col] = pt._PAD_ROW
+        self.base["emeta"][ri, col] = 0
+        n = int(found.sum())
+        self.masked_base += n
+        return n
+
+    def _remove_key(self, k: int, base_rem: List[int]) -> bool:
+        """Remove ``k`` from pending/frozen, or defer it to the batched
+        base lookup; returns False only when deferred."""
+        if k in self.pending:
+            del self.pending[k]
+            return True
+        slot = self.frozen_slot.pop(k, None)
+        if slot is not None:
+            fidx, ri, col = slot
+            prep = self.frozen[fidx]
+            prep["row_pos"][ri, col] = pt._PAD_ROW
+            prep["emeta"][ri, col] = 0
+            self.masked_frozen += 1
+            return True
+        base_rem.append(k)
+        return False
+
+    def apply_log(self, log) -> None:
+        """Batched replay of a pair-transition log [(insert?, src, dst,
+        kind), ...].  Equivalent to calling insert/remove in order
+        (including anomaly accounting for caller-side drift), but
+        base-slot lookups are one vectorized binary search for the whole
+        batch instead of a scalar search per pair (slotmap.fold_log
+        documents the net-effect argument)."""
+        removes, cond_removes, inserts = fold_log(log)
+
+        base_rem: List[int] = []
+        for k in removes:
+            self._remove_key(k, base_rem)
+        if base_rem:
+            vals = self.base_slot.pop_batch(
+                np.fromiter(base_rem, np.int64, len(base_rem))
+            )
+            n_found = self._mask_base_slots(vals)
+            self.stats["anomalies"] += len(base_rem) - n_found
+
+        # Insert-first/remove-last keys: net no-op unless the key was
+        # already live (anomalous duplicate insert followed by a real
+        # remove) — then remove it, like the sequential replay would.
+        cond_base: List[int] = []
+        for k in cond_removes:
+            if k in self.pending or k in self.frozen_slot:
+                self.stats["anomalies"] += 1
+                self._remove_key(k, cond_base)
+            else:
+                cond_base.append(k)
+        if cond_base:
+            vals = self.base_slot.pop_batch(
+                np.fromiter(cond_base, np.int64, len(cond_base))
+            )
+            self.stats["anomalies"] += self._mask_base_slots(vals)
+
+        if inserts:
+            fresh: List[int] = []
+            for k in inserts:
+                if k in self.pending or k in self.frozen_slot:
+                    self.stats["anomalies"] += 1
+                    continue
+                self.pending[k] = None
+                fresh.append(k)
+            if fresh:
+                # Anomalous duplicate-with-base inserts are harmless for
+                # liveness (contributions are OR'd) but tracked for
+                # diagnostics, batched.
+                karr = np.fromiter(fresh, np.int64, len(fresh))
+                present = self.base_slot.get_batch(karr) >= 0
+                n_dup = int(present.sum())
+                if n_dup:
+                    self.stats["anomalies"] += n_dup
+                    for k in karr[present].tolist():
+                        del self.pending[k]
 
     @property
     def churn(self) -> int:
@@ -276,8 +360,7 @@ class IncrementalPallasLayout:
             m = len(self.pending)
             while self._xla_cap < m:
                 self._xla_cap *= 2
-            psrc = np.fromiter((k[0] for k in self.pending), np.int64, m)
-            pdst = np.fromiter((k[1] for k in self.pending), np.int64, m)
+            psrc, pdst = unpack_keys(np.fromiter(self.pending, np.int64, m))
             preps.append(pt.xla_tier(psrc, pdst, self.n, self._xla_cap))
         return preps
 

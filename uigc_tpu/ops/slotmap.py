@@ -1,0 +1,182 @@
+"""Packed-int64 slot maps: O(E) numpy storage for pair -> slot lookups.
+
+The incremental layouts (ops/pallas_incremental.py, engines/crgc/mesh.py)
+need a map from a live propagation pair to the slot holding it, so that a
+later deletion can mask the slot in place.  A Python dict keyed by
+(src, dst, kind) tuples costs hundreds of bytes per pair — multiple GB of
+host objects at the 10M-actor/30M-pair target, and most of the rebuild
+stall measured in BENCH_PACK_r02 was that dict's construction.
+
+This map instead stores the bulk mapping as two sorted int64 numpy arrays
+(16 bytes per pair) built vectorized at rebuild time; point lookups are a
+binary search.  Mutations after the rebuild go through small Python
+overlays (an insert dict and a tombstone set) whose size is bounded by
+churn since the rebuild, which the layouts already bound by repacking.
+
+Keys pack (src, dst, kind) into one int64: src in bits 32..62, dst in
+bits 1..31, kind in bit 0 — node ids must stay below 2^31, which the
+graph's int32 slot arrays already guarantee.  Values are whatever the
+caller packs into an int64.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def pack_keys(src, dst, kind) -> np.ndarray:
+    """Vectorized (src, dst, kind) -> int64 key."""
+    return (
+        (np.asarray(src, dtype=np.int64) << 32)
+        | (np.asarray(dst, dtype=np.int64) << 1)
+        | np.asarray(kind, dtype=np.int64)
+    )
+
+
+def pack_key(src: int, dst: int, kind: int) -> int:
+    return (src << 32) | (dst << 1) | kind
+
+
+def unpack_keys(karr: np.ndarray):
+    """Vectorized int64 key -> (src, dst) arrays (kind = karr & 1)."""
+    karr = np.asarray(karr, dtype=np.int64)
+    return karr >> 32, (karr >> 1) & 0x7FFFFFFF
+
+
+def fold_log(log):
+    """Fold an alternating pair-transition log [(insert?, src, dst, kind),
+    ...] into its net effect per packed key.
+
+    A pair's transitions strictly alternate (graph layers only log
+    dead<->live flips), so the net effect is determined by the first and
+    last op.  Returns ``(removes, cond_removes, inserts)``:
+
+    - ``removes``: first op is a remove — remove from the current home
+      (absence is caller drift: count an anomaly);
+    - ``cond_removes``: insert-first but remove-last — a net no-op for a
+      fresh pair, but if the key was *already live* the insert was
+      anomalous drift and the remove is real: remove and count an
+      anomaly, matching the sequential scalar replay;
+    - ``inserts``: last op is an insert — insert after the removals.
+    """
+    first: dict = {}
+    last: dict = {}
+    for ins, src, dst, kind in log:
+        k = pack_key(src, dst, kind)
+        if k not in first:
+            first[k] = ins
+        last[k] = ins
+    removes = [k for k, ins in first.items() if not ins]
+    cond_removes = [k for k, ins in first.items() if ins and not last[k]]
+    inserts = [k for k, ins in last.items() if ins]
+    return removes, cond_removes, inserts
+
+
+class PackedSlotMap:
+    """int64 key -> int64 value map: sorted bulk arrays + churn overlays."""
+
+    __slots__ = ("_keys", "_vals", "_removed", "_extra")
+
+    def __init__(
+        self,
+        keys: Optional[np.ndarray] = None,
+        vals: Optional[np.ndarray] = None,
+    ):
+        if keys is None or keys.size == 0:
+            self._keys = np.zeros(0, dtype=np.int64)
+            self._vals = np.zeros(0, dtype=np.int64)
+        else:
+            order = np.argsort(keys)
+            self._keys = np.ascontiguousarray(keys[order])
+            self._vals = np.ascontiguousarray(vals[order])
+        self._removed: set = set()  # tombstoned bulk keys
+        self._extra: dict = {}  # post-rebuild inserts
+
+    def __len__(self) -> int:
+        return self._keys.size - len(self._removed) + len(self._extra)
+
+    def _bulk_find(self, key: int) -> int:
+        """Index of ``key`` in the sorted bulk arrays, or -1."""
+        keys = self._keys
+        i = int(np.searchsorted(keys, key))
+        if i < keys.size and keys[i] == key:
+            return i
+        return -1
+
+    def __contains__(self, key: int) -> bool:
+        if key in self._extra:
+            return True
+        if key in self._removed:
+            return False
+        return self._bulk_find(key) >= 0
+
+    def get(self, key: int) -> Optional[int]:
+        val = self._extra.get(key)
+        if val is not None:
+            return val
+        if key in self._removed:
+            return None
+        i = self._bulk_find(key)
+        if i < 0:
+            return None
+        return int(self._vals[i])
+
+    def add(self, key: int, val: int) -> None:
+        """Insert; the key must not be present (callers check first).
+        A tombstoned bulk key may be re-added — the overlay wins on
+        lookup, and the tombstone keeps the stale bulk slot hidden."""
+        self._extra[key] = val
+
+    def pop(self, key: int) -> Optional[int]:
+        val = self._extra.pop(key, None)
+        if val is not None:
+            return val
+        if key in self._removed:
+            return None
+        i = self._bulk_find(key)
+        if i < 0:
+            return None
+        self._removed.add(key)
+        return int(self._vals[i])
+
+    # --------------------------------------------------------------- #
+    # Batched point ops: one vectorized binary search for a whole churn
+    # batch instead of a ~1us scalar searchsorted per key.
+    # --------------------------------------------------------------- #
+
+    def _lookup_batch(self, karr: np.ndarray, remove: bool) -> np.ndarray:
+        # Precondition: keys within one batch are unique (callers dedup
+        # via fold_log).  A duplicated bulk key would otherwise be
+        # tombstoned once but resolved for every occurrence — e.g. a
+        # double-free of the same column downstream.
+        assert np.unique(karr).size == karr.size, "batch keys must be unique"
+        out = np.full(karr.size, -1, dtype=np.int64)
+        extra = self._extra
+        removed = self._removed
+        bulk_idx = []
+        for i, k in enumerate(karr.tolist()):
+            if k in extra:
+                out[i] = extra.pop(k) if remove else extra[k]
+            elif k not in removed:
+                bulk_idx.append(i)
+        if bulk_idx and self._keys.size:
+            bi = np.asarray(bulk_idx, dtype=np.int64)
+            kq = karr[bi]
+            pos = np.minimum(
+                np.searchsorted(self._keys, kq), self._keys.size - 1
+            )
+            found = self._keys[pos] == kq
+            out[bi[found]] = self._vals[pos[found]]
+            if remove:
+                removed.update(kq[found].tolist())
+        return out
+
+    def pop_batch(self, karr: np.ndarray) -> np.ndarray:
+        """Pop every key in ``karr``; returns int64 values, -1 = absent."""
+        return self._lookup_batch(np.asarray(karr, dtype=np.int64), remove=True)
+
+    def get_batch(self, karr: np.ndarray) -> np.ndarray:
+        """Look up every key in ``karr``; returns int64 values, -1 = absent."""
+        return self._lookup_batch(np.asarray(karr, dtype=np.int64), remove=False)
