@@ -416,12 +416,16 @@ def _build_trace_fn_multi(
         # (1024, 128) contraction per block.
         sub_iota = jax.lax.broadcasted_iota(jnp.int32, (s_rows, LANE), 0)
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
-        zero_a = jnp.zeros((s_rows, LANE), jnp.bfloat16)
         a_parts = []
         b_parts = []
         for r in range(ROWS):
+            # Mask-multiply instead of jnp.where: a where() whose selected
+            # operand is a sublane-broadcast bf16 vector does not lower
+            # through Mosaic on the current TPU toolchain.  vals is 0/1
+            # bits, so the product is bit-identical to the select.
             a_parts.append(
-                jnp.where(sub_iota == dst_sub[r, :][None, :], vals[r, :][None, :], zero_a)
+                (sub_iota == dst_sub[r, :][None, :]).astype(jnp.bfloat16)
+                * vals[r, :][None, :]
             )
             b_parts.append(
                 (lane_iota == dst_lane[r, :][:, None]).astype(jnp.bfloat16)

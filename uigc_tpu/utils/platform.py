@@ -15,7 +15,10 @@ def apply_platform_override(default: str | None = None) -> None:
     """Apply ``JAX_PLATFORMS`` (or ``default`` when unset/empty) through
     the config API.  An explicit TPU request is honored as-is."""
     env = os.environ.get("JAX_PLATFORMS") or default
-    if env and "tpu" not in env.lower():
+    low = (env or "").lower()
+    # "axon" is the TPU tunnel plugin on this host — a real chip, so it
+    # counts as an explicit TPU request (matches bench.py's treatment).
+    if env and "tpu" not in low and "axon" not in low:
         # Also export the env var so JAX's own platform resolution at
         # first backend init picks it up even if the config call fails.
         os.environ["JAX_PLATFORMS"] = env
