@@ -27,6 +27,8 @@ import subprocess
 import sys
 import time
 
+from uigc_tpu.utils.platform import is_tpu_platform, is_tpu_request
+
 
 def probe_platform(
     timeout_s: float = None, attempts: int = None, backoff_s: float = 5.0
@@ -47,10 +49,10 @@ def probe_platform(
     if attempts is None:
         attempts = int(os.environ.get("UIGC_BENCH_PROBE_ATTEMPTS", "3"))
     forced = os.environ.get("JAX_PLATFORMS", "").lower()
-    # "axon" is this machine's TPU tunnel plugin (it reports the real
-    # chip); both it and "tpu" need the guarded probe.  Anything else
-    # explicitly forced (cpu, ...) is honored as-is.
-    device_like = (not forced) or ("tpu" in forced) or ("axon" in forced)
+    # A real-TPU request (incl. this machine's "axon" tunnel plugin)
+    # needs the guarded probe.  Anything else explicitly forced
+    # (cpu, ...) is honored as-is.
+    device_like = (not forced) or is_tpu_request(forced)
     if not device_like:
         return {"platform": forced.split(",")[0], "degraded": False, "probe": "forced"}
 
@@ -173,8 +175,7 @@ def main() -> None:
                 )
             )
             return
-    # "axon" is the TPU tunnel plugin: a real chip behind a relay.
-    is_tpu = platform in ("tpu", "axon")
+    is_tpu = is_tpu_platform(platform)
     if args.n is None:
         if args.small:
             n = 1 << 16

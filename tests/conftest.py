@@ -1,5 +1,16 @@
-"""Test environment: force JAX onto a virtual 8-device CPU mesh so
-multi-chip sharding is exercised without TPU hardware.
+"""Test environment: two tiers.
+
+Default tier: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding is exercised without TPU hardware; Pallas kernels run in
+interpret mode.  Tests marked ``@pytest.mark.tpu`` are *skipped* (visibly)
+in this tier.
+
+Compiled tier (``UIGC_TEST_TPU=1 python -m pytest tests/ -q``): the CPU
+pin is lifted, only ``tpu``-marked tests run, and they compile the Pallas
+kernels for real on the ambient TPU (``tpu`` or this host's ``axon``
+tunnel plugin).  This tier exists because interpret mode cannot catch
+Mosaic lowering failures — a kernel that traces fine on CPU can still be
+uncompilable on hardware (VERDICT r3: the bf16 where-broadcast bug).
 
 Note: on this machine an 'axon' TPU plugin wins platform selection even
 when JAX_PLATFORMS=cpu is set in the environment; only
@@ -9,18 +20,57 @@ XLA_FLAGS must be set before backend initialization.
 
 import os
 
+#: Compiled-on-TPU tier requested?
+TPU_MODE = os.environ.get("UIGC_TEST_TPU", "") not in ("", "0")
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not TPU_MODE and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not TPU_MODE:
+    jax.config.update("jax_platforms", "cpu")
 
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: compiled-on-TPU parity tier (run with UIGC_TEST_TPU=1 on a "
+        "machine with a real chip; skipped in the default CPU tier)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if TPU_MODE:
+        from uigc_tpu.utils.platform import is_tpu_platform
+
+        if not is_tpu_platform(jax.devices()[0].platform):
+            # An explicit opt-in with no chip must fail, not all-skip to
+            # green — the tier's whole purpose is catching compile breaks.
+            pytest.exit(
+                "UIGC_TEST_TPU=1 but no TPU device is visible "
+                f"(platform={jax.devices()[0].platform!r})",
+                returncode=2,
+            )
+        skip_cpu = pytest.mark.skip(
+            reason="UIGC_TEST_TPU=1: only the compiled-TPU tier runs"
+        )
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip_cpu)
+    else:
+        skip_tpu = pytest.mark.skip(
+            reason="needs a real TPU: run UIGC_TEST_TPU=1 python -m pytest tests/"
+        )
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip_tpu)
 
 from uigc_tpu import native as _native  # noqa: E402
 

@@ -78,7 +78,7 @@ class GroundTruth:
         )
 
 
-def run_history(seed, n, steps, check_every, **layout_kw):
+def run_history(seed, n, steps, check_every, interpret=True, **layout_kw):
     rng = np.random.default_rng(seed)
     gt = GroundTruth(rng, n)
     # seed an initial population so the base layout is non-trivial
@@ -89,7 +89,7 @@ def run_history(seed, n, steps, check_every, **layout_kw):
     sup_mask = rng.random(n) < 0.3
     gt.supervisor[sup_mask] = rng.integers(0, n, size=int(sup_mask.sum()))
 
-    layout = pinc.IncrementalPallasLayout(n, interpret=True, **layout_kw)
+    layout = pinc.IncrementalPallasLayout(n, interpret=interpret, **layout_kw)
     src, dst, w = gt.edge_arrays()
     layout.rebuild(src, dst, w, gt.supervisor)
 

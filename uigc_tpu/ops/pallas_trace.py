@@ -556,11 +556,13 @@ def _build_trace_fn_multi(
 
 def default_interpret() -> bool:
     """Interpret mode defaults to True off-TPU (Mosaic can't compile
-    there).  The "axon" platform is a TPU tunnel plugin — a real chip —
-    so it compiles for real."""
+    there); on a real chip (incl. the "axon" tunnel plugin) it compiles
+    for real."""
     import jax
 
-    return jax.devices()[0].platform not in ("tpu", "axon")
+    from ..utils.platform import is_tpu_platform
+
+    return not is_tpu_platform(jax.devices()[0].platform)
 
 
 def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):

@@ -1,24 +1,40 @@
-"""Platform-selection helper for entry points.
+"""Platform-selection helpers for entry points.
 
 A TPU plugin on this host can win JAX platform selection over the
 ``JAX_PLATFORMS`` env var; only the config API reliably overrides it, and
 it must run before the first backend initialization.  Entry points call
-this right after ``import jax``; an explicit TPU request is left alone.
+:func:`apply_platform_override` right after ``import jax``; an explicit
+TPU request is left alone.
+
+This module is the single home of the "which platform names are a real
+TPU" knowledge — ``axon`` is this machine's TPU tunnel plugin, a real
+chip behind a relay.
 """
 
 from __future__ import annotations
 
 import os
 
+#: Platform names that mean "a real TPU chip".
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def is_tpu_platform(name: str) -> bool:
+    """True when a ``jax.Device.platform`` value is a real TPU."""
+    return name.lower() in TPU_PLATFORMS
+
+
+def is_tpu_request(env: str | None) -> bool:
+    """True when a ``JAX_PLATFORMS``-style string requests a real TPU."""
+    low = (env or "").lower()
+    return any(p in low for p in TPU_PLATFORMS)
+
 
 def apply_platform_override(default: str | None = None) -> None:
     """Apply ``JAX_PLATFORMS`` (or ``default`` when unset/empty) through
     the config API.  An explicit TPU request is honored as-is."""
     env = os.environ.get("JAX_PLATFORMS") or default
-    low = (env or "").lower()
-    # "axon" is the TPU tunnel plugin on this host — a real chip, so it
-    # counts as an explicit TPU request (matches bench.py's treatment).
-    if env and "tpu" not in low and "axon" not in low:
+    if env and not is_tpu_request(env):
         # Also export the env var so JAX's own platform resolution at
         # first backend init picks it up even if the config call fails.
         os.environ["JAX_PLATFORMS"] = env
@@ -28,3 +44,16 @@ def apply_platform_override(default: str | None = None) -> None:
             jax.config.update("jax_platforms", env)
         except Exception:
             pass
+
+
+def force_cpu_backend() -> None:
+    """Switch an already-initialized JAX onto the CPU backend: export the
+    env var (for subprocesses and late env re-resolution), update the
+    config, and drop the existing backends so the next ``jax.devices()``
+    re-selects."""
+    import jax
+    from jax.extend import backend as _jeb
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    _jeb.clear_backends()
