@@ -27,7 +27,9 @@ import subprocess
 import sys
 import time
 
-from uigc_tpu.utils.platform import is_tpu_platform, is_tpu_request
+# uigc_tpu imports stay function-local: the module-level code here must
+# not touch the package (and transitively jax) before probe_platform has
+# subprocess-guarded the flaky TPU backend.
 
 
 def probe_platform(
@@ -44,6 +46,8 @@ def probe_platform(
     chosen platform and whether it is a degradation, so the emitted
     result line always carries a visible ``"platform"``.
     """
+    from uigc_tpu.utils.platform import is_tpu_request
+
     if timeout_s is None:
         timeout_s = float(os.environ.get("UIGC_BENCH_PROBE_TIMEOUT", "240"))
     if attempts is None:
@@ -88,8 +92,10 @@ def probe_platform(
     # (stderr warning + "platform_degraded" in the result line).  Set
     # UIGC_BENCH_STRICT_PLATFORM=1 to fail loudly instead — e.g. a CI
     # gate that must never accept a CPU number against the TPU target.
+    from uigc_tpu.utils.platform import env_flag
+
     detail = "; ".join(log)
-    if os.environ.get("UIGC_BENCH_STRICT_PLATFORM") == "1":
+    if env_flag("UIGC_BENCH_STRICT_PLATFORM"):
         raise RuntimeError(f"TPU backend unavailable (strict mode): {detail}")
     print(f"bench: TPU backend unavailable, degrading to CPU ({detail})", file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -129,7 +135,7 @@ def main() -> None:
 
     import jax
 
-    from uigc_tpu.utils.platform import apply_platform_override
+    from uigc_tpu.utils.platform import apply_platform_override, is_tpu_platform
 
     apply_platform_override()
 

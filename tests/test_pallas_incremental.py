@@ -89,6 +89,11 @@ def run_history(seed, n, steps, check_every, interpret=True, **layout_kw):
     sup_mask = rng.random(n) < 0.3
     gt.supervisor[sup_mask] = rng.integers(0, n, size=int(sup_mask.sum()))
 
+    # s_rows=8 keeps supertiles at 1024 nodes so these graph sizes span
+    # several of them (the compact-tier super_ids scatter and out-block
+    # revisit logic need multi-supertile coverage; the production default
+    # of 32 would collapse n=2500 into one supertile).
+    layout_kw.setdefault("s_rows", 8)
     layout = pinc.IncrementalPallasLayout(n, interpret=interpret, **layout_kw)
     src, dst, w = gt.edge_arrays()
     layout.rebuild(src, dst, w, gt.supervisor)
@@ -111,7 +116,7 @@ def run_history(seed, n, steps, check_every, interpret=True, **layout_kw):
 
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_incremental_matches_oracle(seed):
-    # n spans multiple supertiles (super = s_rows * 128 = 1024 nodes)
+    # n spans multiple supertiles (super = 8 * 128 = 1024 nodes here)
     layout = run_history(seed, n=2500, steps=600, check_every=60)
     # the whole point: churn was absorbed without full repacks
     assert layout.stats["rebuilds"] == 1
@@ -145,7 +150,7 @@ def test_delete_then_reinsert_base_pair():
     gt.flags[a] |= F.FLAG_ROOT
     gt.edges[(a, b)] = True
     gt.edges[(b, c)] = True
-    layout = pinc.IncrementalPallasLayout(n, interpret=True)
+    layout = pinc.IncrementalPallasLayout(n, s_rows=8, interpret=True)
     src, dst, w = gt.edge_arrays()
     layout.rebuild(src, dst, w, gt.supervisor)
     assert layout.trace(gt.flags, gt.recv)[c]

@@ -1,0 +1,178 @@
+"""Fold + sweep throughput benchmark for the array shadow graph.
+
+Measures, at graph scale, the two collector hot paths the reference runs
+per 50ms wake (LocalGC.scala:149-177 / ShadowGraph.java:75-125,273-289):
+
+- **fold**: merging a drained batch of mutator entries — the per-entry
+  scalar path (``merge_entry`` loop, the pre-r4 collector) vs the batched
+  vectorized path (``merge_entries``);
+- **sweep**: freeing every garbage slot after a trace — timed at >=1M
+  garbage actors through the vectorized ``_free_slots_batch``.
+
+Prints one JSON object; commit the output as ``BENCH_FOLD_r{N}.json``.
+
+Usage: python tools/fold_bench.py [--actors 1000000] [--entries 200000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from uigc_tpu.engines.crgc import refob as refob_info
+from uigc_tpu.engines.crgc.arrays import ArrayShadowGraph
+from uigc_tpu.engines.crgc.refob import CrgcRefob
+from uigc_tpu.engines.crgc.state import CrgcContext, Entry
+from uigc_tpu.ops import trace as trace_ops
+
+
+class FakeSystem:
+    def __init__(self, address="uigc://foldbench"):
+        self.address = address
+
+
+class FakeCell:
+    __slots__ = ("uid", "path", "system")
+    _count = 0
+
+    def __init__(self, system):
+        FakeCell._count += 1
+        self.uid = FakeCell._count
+        self.path = f"/bench/{self.uid}"
+        self.system = system
+
+    def tell(self, msg):
+        pass
+
+
+def synth_entries(cells, rng, n_entries, context, fanout=4):
+    """Entry stream shaped like a busy system: every entry snapshots one
+    actor (busy bit, recv count), creates a few refs to random targets,
+    and deactivates a couple of older ones."""
+    system_refs = [CrgcRefob(c) for c in cells]
+    entries = []
+    n = len(cells)
+    owners = rng.integers(0, n, size=(n_entries, fanout))
+    targets = rng.integers(0, n, size=(n_entries, fanout))
+    deact = rng.integers(0, n, size=(n_entries, 2))
+    selfs = rng.integers(0, n, size=n_entries)
+    for i in range(n_entries):
+        e = Entry(context)
+        e.self_ref = system_refs[selfs[i]]
+        e.is_busy = bool(i & 1)
+        e.is_root = False
+        e.recv_count = 3
+        for j in range(fanout):
+            e.created_owners[j] = system_refs[owners[i, j]]
+            e.created_targets[j] = system_refs[targets[i, j]]
+        for j in range(2):
+            e.updated_refs[j] = system_refs[deact[i, j]]
+            # packed RefobInfo: two sends, deactivated
+            info = refob_info.inc_send_count(
+                refob_info.inc_send_count(refob_info.ACTIVE_REFOB)
+            )
+            e.updated_infos[j] = refob_info.deactivate(info)
+        entries.append(e)
+    return entries
+
+
+def bench_fold(n_actors, n_entries, seed=0):
+    context = CrgcContext(delta_graph_size=64, entry_field_size=8)
+    system = FakeSystem()
+    cells = [FakeCell(system) for _ in range(n_actors)]
+
+    results = {}
+    for mode in ("scalar", "batched"):
+        graph = ArrayShadowGraph(context, system.address, use_device=False)
+        # pre-intern every actor so both modes measure fold, not interning
+        for c in cells:
+            graph.slot_for(c)
+        # identical entry stream for both modes
+        rng = np.random.default_rng(seed)
+        entries = synth_entries(cells, rng, n_entries, context)
+        t0 = time.perf_counter()
+        if mode == "scalar":
+            for e in entries:
+                graph.merge_entry(e)
+        else:
+            graph.merge_entries(entries)
+        dt = time.perf_counter() - t0
+        results[mode] = {
+            "seconds": round(dt, 4),
+            "entries_per_sec": round(n_entries / dt, 1),
+            "edges_after": len(graph.edge_of),
+        }
+        results[f"_graph_{mode}"] = graph
+    # the two modes must agree on the resulting graph
+    ga = results.pop("_graph_scalar")
+    gb = results.pop("_graph_batched")
+    agree = (
+        np.array_equal(ga.flags, gb.flags)
+        and np.array_equal(ga.recv_count, gb.recv_count)
+        and np.array_equal(ga.supervisor, gb.supervisor)
+        and ga.edge_of.keys() == gb.edge_of.keys()
+        and all(
+            ga.edge_weight[ga.edge_of[k]] == gb.edge_weight[gb.edge_of[k]]
+            for k in ga.edge_of
+        )
+    )
+    results["modes_agree"] = bool(agree)
+    results["speedup"] = round(
+        results["batched"]["entries_per_sec"]
+        / results["scalar"]["entries_per_sec"],
+        2,
+    )
+    return results, gb, cells
+
+
+def bench_sweep(graph, cells, n_actors, seed=1):
+    """Mark ~all actors garbage (no roots/busy/recv) and time the sweep."""
+    # silence: no roots, no busy, no pending receives -> everything
+    # non-interned seeds... make all interned, none busy/root, recv 0
+    graph.flags[: len(cells)] |= trace_ops.FLAG_INTERNED
+    graph.flags[: len(cells)] &= ~np.uint8(
+        int(trace_ops.FLAG_BUSY) | int(trace_ops.FLAG_ROOT)
+    )
+    graph.recv_count[:] = 0
+    n_edges_before = len(graph.edge_of)
+    t0 = time.perf_counter()
+    n_freed = graph.trace(should_kill=True)
+    dt = time.perf_counter() - t0
+    return {
+        "garbage_freed": n_freed,
+        "edges_freed": n_edges_before - len(graph.edge_of),
+        "seconds": round(dt, 4),
+        "garbage_actors_per_sec": round(n_freed / dt, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int, default=1_000_000)
+    ap.add_argument("--entries", type=int, default=200_000)
+    args = ap.parse_args()
+
+    fold, graph, cells = bench_fold(args.actors, args.entries)
+    sweep = bench_sweep(graph, cells, args.actors)
+    print(
+        json.dumps(
+            {
+                "bench": "fold+sweep",
+                "n_actors": args.actors,
+                "n_entries": args.entries,
+                "fold": fold,
+                "sweep": sweep,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

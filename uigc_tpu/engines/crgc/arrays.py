@@ -74,11 +74,12 @@ class ArrayShadowGraph:
         self.edge_src = np.zeros(ecap, dtype=np.int32)
         self.edge_dst = np.zeros(ecap, dtype=np.int32)
         self.edge_weight = np.zeros(ecap, dtype=np.int64)
-        self.edge_of: Dict[tuple, int] = {}
+        #: packed (owner << 32 | target) int64 key -> edge id.  An edge is
+        #: allocated iff its weight is nonzero, which is what lets the
+        #: sweep find every edge incident to a garbage set with one
+        #: vectorized scan instead of per-slot incident sets.
+        self.edge_of: Dict[int, int] = {}
         self.free_edges: List[int] = list(range(ecap - 1, -1, -1))
-        #: per-slot incident edge ids, for O(degree) deletion at sweep
-        self.out_edges: List[Set[int]] = [set() for _ in range(cap)]
-        self.in_edges: List[Set[int]] = [set() for _ in range(cap)]
 
         #: changelog of pair transitions since the Pallas layout last
         #: consumed it: (insert?, src, dst, kind).  ``None`` means either
@@ -111,8 +112,6 @@ class ArrayShadowGraph:
         )
         self.cells.extend([None] * old)
         self.locations.extend([None] * old)
-        self.out_edges.extend(set() for _ in range(old))
-        self.in_edges.extend(set() for _ in range(old))
         self.free_slots.extend(range(new - 1, old - 1, -1))
         self.capacity = new
         # Node capacity sets the bit-table/supertile geometry: the whole
@@ -169,9 +168,25 @@ class ArrayShadowGraph:
             return
         log.append((insert, src, dst, kind))
 
+    def _log_pairs_batch(
+        self, insert: bool, srcs: np.ndarray, dsts: np.ndarray, kind: int
+    ) -> None:
+        """Batched :meth:`_log_pair`; collapses to the rebuild sentinel
+        when the batch would overflow the log (a sweep that frees a large
+        fraction of the graph crosses the layout's repack threshold
+        anyway)."""
+        log = self._pair_log
+        k = len(srcs)
+        if log is None or k == 0:
+            return
+        if len(log) + k > self._log_cap:
+            self._pair_log = None
+            return
+        log.extend(zip([insert] * k, srcs.tolist(), dsts.tolist(), [kind] * k))
+
     def _update_edge(self, owner: int, target: int, delta: int) -> None:
         """Zero-count edges are deleted (reference: ShadowGraph.java:64-73)."""
-        key = (owner, target)
+        key = (owner << 32) | target
         eid = self.edge_of.get(key)
         if eid is None:
             if delta == 0:
@@ -183,8 +198,6 @@ class ArrayShadowGraph:
             self.edge_src[eid] = owner
             self.edge_dst[eid] = target
             self.edge_weight[eid] = delta
-            self.out_edges[owner].add(eid)
-            self.in_edges[target].add(eid)
             if delta > 0:
                 self._log_pair(True, owner, target, _PAIR_EDGE)
             return
@@ -205,10 +218,8 @@ class ArrayShadowGraph:
         target = int(self.edge_dst[eid])
         if self.edge_weight[eid] > 0:
             self._log_pair(False, owner, target, _PAIR_EDGE)
-        self.edge_of.pop((owner, target), None)
+        self.edge_of.pop((owner << 32) | target, None)
         self.edge_weight[eid] = 0
-        self.out_edges[owner].discard(eid)
-        self.in_edges[target].discard(eid)
         self.free_edges.append(eid)
 
     def _set_supervisor(self, child_slot: int, new_sup: int) -> None:
@@ -271,6 +282,199 @@ class ArrayShadowGraph:
                 self._touch(target_slot)
             if not refob_info.is_active(info):
                 self._update_edge(self_slot, target_slot, -1)
+
+    def merge_entries(self, entries) -> None:
+        """Batched fold of a drained entry queue: one pass of Python to
+        flatten the object-world entries into slot arrays, then vectorized
+        scatter-applies — instead of per-refob field loops per entry
+        (reference semantics: ShadowGraph.java:75-125, applied per wake at
+        LocalGC.scala:149-177 cadence).
+
+        Equivalent to ``merge_entry`` in queue order: busy/root are
+        last-writer-wins per actor, receive counts are commutative sums,
+        and edge deltas are aggregated to their per-pair net effect (the
+        layout cares only about liveness transitions of the *final* weight
+        against the initial one, and intermediate flip-flops fold to
+        net no-ops — the same argument slotmap.fold_log documents)."""
+        slot_for = self.slot_for
+        slot_of_get = self.slot_of.get
+
+        self_slots: List[int] = []
+        busyroot: List[int] = []
+        recv_deltas: List[int] = []
+        ek: List[int] = []  # packed (owner << 32 | target) edge keys
+        esign: List[int] = []
+        sp_child: List[int] = []
+        sp_parent: List[int] = []
+
+        busy = int(_F.FLAG_BUSY)
+        root = int(_F.FLAG_ROOT)
+        rows_append = self_slots.append
+        br_append = busyroot.append
+        rd_append = recv_deltas.append
+        ek_append = ek.append
+        es_append = esign.append
+
+        for entry in entries:
+            sc = entry.self_ref._target
+            self_slot = slot_of_get(sc)
+            if self_slot is None:
+                self_slot = slot_for(sc)
+            rows_append(self_slot)
+            br_append(
+                (busy if entry.is_busy else 0) | (root if entry.is_root else 0)
+            )
+            rd_append(entry.recv_count)
+
+            for owner, target in zip(
+                entry.created_owners, entry.created_targets
+            ):
+                if owner is None:
+                    break
+                oc = owner._target
+                tc = target._target
+                os_ = slot_of_get(oc)
+                if os_ is None:
+                    os_ = slot_for(oc)
+                ts = slot_of_get(tc)
+                if ts is None:
+                    ts = slot_for(tc)
+                ek_append((os_ << 32) | ts)
+                es_append(1)
+
+            for child in entry.spawned_actors:
+                if child is None:
+                    break
+                cc = child._target
+                cs = slot_of_get(cc)
+                if cs is None:
+                    cs = slot_for(cc)
+                sp_child.append(cs)
+                sp_parent.append(self_slot)
+
+            for target, info in zip(entry.updated_refs, entry.updated_infos):
+                if target is None:
+                    break
+                tc = target._target
+                target_slot = slot_of_get(tc)
+                if target_slot is None:
+                    target_slot = slot_for(tc)
+                send_count = info >> 1
+                if send_count > 0:
+                    rows_append(target_slot)
+                    br_append(-1)  # recv-only row
+                    rd_append(-send_count)
+                if info & 1:  # deactivated (refob_info.is_active == False)
+                    ek_append((self_slot << 32) | target_slot)
+                    es_append(-1)
+        if self_slots:
+            sl = np.asarray(self_slots, dtype=np.int64)
+            rd = np.asarray(recv_deltas, dtype=np.int64)
+            np.add.at(self.recv_count, sl, rd)
+            br = np.asarray(busyroot, dtype=np.int64)
+            selfrows = br >= 0
+            ssl = sl[selfrows]
+            sbr = br[selfrows]
+            # Last entry wins busy/root: unique() on the reversed slot
+            # array returns each slot's first reversed occurrence = its
+            # last occurrence in queue order.
+            u, ridx = np.unique(ssl[::-1], return_index=True)
+            last_bits = sbr[::-1][ridx].astype(np.uint8)
+            f = self.flags
+            keep = np.uint8(0xFF & ~(int(_F.FLAG_BUSY) | int(_F.FLAG_ROOT)))
+            f[u] = (
+                (f[u] | np.uint8(int(_F.FLAG_INTERNED) | int(_F.FLAG_LOCAL)))
+                & keep
+            ) | last_bits
+            if self._node_log is not None:
+                self._node_log.update(sl.tolist())
+
+        if sp_child:
+            ch = np.asarray(sp_child, dtype=np.int64)
+            pa = np.asarray(sp_parent, dtype=np.int64)
+            u, ridx = np.unique(ch[::-1], return_index=True)
+            newp = pa[::-1][ridx]
+            old = self.supervisor[u].astype(np.int64)
+            changed = old != newp
+            uu, oo, nn = u[changed], old[changed], newp[changed]
+            has_old = oo >= 0
+            self._log_pairs_batch(False, uu[has_old], oo[has_old], _PAIR_SUP)
+            self._log_pairs_batch(True, uu, nn, _PAIR_SUP)
+            self.supervisor[uu] = nn
+
+        if ek:
+            karr = np.asarray(ek, dtype=np.int64)
+            sarr = np.asarray(esign, dtype=np.int64)
+            u, inv = np.unique(karr, return_inverse=True)
+            delta = np.zeros(u.size, dtype=np.int64)
+            np.add.at(delta, inv, sarr)
+            nz = delta != 0
+            self._apply_edge_deltas(u[nz], delta[nz])
+
+    def _apply_edge_deltas(self, keys: np.ndarray, deltas: np.ndarray) -> None:
+        """Vectorized ``_update_edge`` over unique packed keys with
+        nonzero net deltas: bulk id allocation, array scatter, batch dict
+        update, and batched liveness-transition logging."""
+        eo = self.edge_of
+        eids = np.fromiter(
+            (eo.get(k, -1) for k in keys.tolist()), np.int64, keys.size
+        )
+        existing = eids >= 0
+
+        ex_eids = eids[existing]
+        if ex_eids.size:
+            w = self.edge_weight
+            ex_keys = keys[existing]
+            w_old = w[ex_eids]
+            w_new = w_old + deltas[existing]
+            live_old = w_old > 0
+            live_new = w_new > 0
+            went_live = ~live_old & live_new
+            went_dead = live_old & ~live_new
+            if went_live.any():
+                self._log_pairs_batch(
+                    True,
+                    ex_keys[went_live] >> 32,
+                    ex_keys[went_live] & 0xFFFFFFFF,
+                    _PAIR_EDGE,
+                )
+            if went_dead.any():
+                self._log_pairs_batch(
+                    False,
+                    ex_keys[went_dead] >> 32,
+                    ex_keys[went_dead] & 0xFFFFFFFF,
+                    _PAIR_EDGE,
+                )
+            w[ex_eids] = w_new
+            freed = w_new == 0
+            if freed.any():
+                fr = ex_eids[freed]
+                w[fr] = 0
+                self.free_edges.extend(fr.tolist())
+                for k in ex_keys[freed].tolist():
+                    del eo[k]
+
+        new_keys = keys[~existing]
+        if new_keys.size:
+            d_new = deltas[~existing]
+            need = int(new_keys.size)
+            while len(self.free_edges) < need:
+                self._grow_edges()
+            alloc = self.free_edges[-need:]
+            del self.free_edges[-need:]
+            aa = np.asarray(alloc, dtype=np.int64)
+            self.edge_src[aa] = (new_keys >> 32).astype(np.int32)
+            self.edge_dst[aa] = (new_keys & 0xFFFFFFFF).astype(np.int32)
+            self.edge_weight[aa] = d_new
+            eo.update(zip(new_keys.tolist(), alloc))
+            pos = d_new > 0
+            if pos.any():
+                self._log_pairs_batch(
+                    True,
+                    new_keys[pos] >> 32,
+                    new_keys[pos] & 0xFFFFFFFF,
+                    _PAIR_EDGE,
+                )
 
     def merge_delta(self, delta) -> None:
         """Fold a peer node's compressed batch
@@ -405,34 +609,67 @@ class ArrayShadowGraph:
             kill_slots = np.nonzero(kill)[0]
 
             if should_kill:
-                for slot in kill_slots:
-                    self.cells[slot].tell(StopMsg)
+                cells = self.cells
+                for slot in kill_slots.tolist():
+                    cells[slot].tell(StopMsg)
 
-            for slot in garbage_slots:
-                self._free_slot(int(slot))
+            if garbage_slots.size:
+                self._free_slots_batch(garbage, garbage_slots)
 
             ev.fields["num_garbage_actors"] = int(garbage_slots.size)
             ev.fields["num_live_actors"] = int(np.count_nonzero(mark))
         return int(garbage_slots.size)
 
-    def _free_slot(self, slot: int) -> None:
-        cell = self.cells[slot]
-        if cell is not None:
-            self.slot_of.pop(cell, None)
-        self.cells[slot] = None
-        self.locations[slot] = None
-        self.flags[slot] = 0
-        self.recv_count[slot] = 0
-        self._touch(slot)
-        self._set_supervisor(slot, -1)
-        for eid in list(self.out_edges[slot]):
-            self._free_edge(eid)
-        for eid in list(self.in_edges[slot]):
-            self._free_edge(eid)
-        # Supervisor pointers into this slot: the pointing nodes are
-        # garbage in the same sweep (a live child marks its supervisor),
-        # and are freed alongside; clear defensively anyway.
-        self.free_slots.append(slot)
+    def _free_slots_batch(
+        self, garbage: np.ndarray, garbage_slots: np.ndarray
+    ) -> None:
+        """Free every garbage slot in one vectorized pass (the sweep,
+        reference: ShadowGraph.java:273-289).
+
+        Incident edges are found by scanning the flat edge arrays — an
+        edge is allocated iff its weight is nonzero — instead of per-slot
+        incident sets, so the sweep is O(edge capacity) numpy + O(dead
+        edges) dict deletions rather than Python set surgery per slot.
+
+        Supervisor pointers *into* a garbage slot need no scan: a live,
+        non-halted child marks its supervisor, so the pointing node is
+        garbage in the same sweep and its pointer is cleared here too."""
+        w = self.edge_weight
+        em = (w != 0) & (garbage[self.edge_src] | garbage[self.edge_dst])
+        eids = np.nonzero(em)[0]
+        if eids.size:
+            srcs = self.edge_src[eids]
+            dsts = self.edge_dst[eids]
+            live = w[eids] > 0
+            self._log_pairs_batch(False, srcs[live], dsts[live], _PAIR_EDGE)
+            eo = self.edge_of
+            for k in ((srcs.astype(np.int64) << 32) | dsts).tolist():
+                eo.pop(k, None)
+            w[eids] = 0
+            self.free_edges.extend(eids.tolist())
+
+        sup = self.supervisor[garbage_slots]
+        has_sup = sup >= 0
+        self._log_pairs_batch(
+            False, garbage_slots[has_sup], sup[has_sup], _PAIR_SUP
+        )
+        self.supervisor[garbage_slots] = -1
+        self.flags[garbage_slots] = 0
+        self.recv_count[garbage_slots] = 0
+
+        cells = self.cells
+        locations = self.locations
+        slot_of = self.slot_of
+        slots_list = garbage_slots.tolist()
+        for slot in slots_list:
+            cell = cells[slot]
+            if cell is not None:
+                slot_of.pop(cell, None)
+                cells[slot] = None
+            locations[slot] = None
+        self.free_slots.extend(slots_list)
+        if self._node_log is not None:
+            self._node_log.update(slots_list)
 
     # ------------------------------------------------------------- #
     # Waves (reference: ShadowGraph.java:291-299)

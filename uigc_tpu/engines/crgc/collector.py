@@ -269,19 +269,30 @@ class Bookkeeper(RawBehavior):
         count = 0
         multi = self.multi_node
         with events.recorder.timed(events.PROCESSING_ENTRIES) as ev:
+            batch = []
             while True:
                 try:
                     entry = queue.popleft()
                 except IndexError:
                     break
                 count += 1
-                self.shadow_graph.merge_entry(entry)
+                batch.append(entry)
                 if multi:
                     self.delta_graph.merge_entry(entry)
                     if self.delta_graph.is_full():
                         self.finalize_delta_graph()
-                entry.clean()
-                pool.append(entry)
+            if batch:
+                merge_entries = getattr(self.shadow_graph, "merge_entries", None)
+                if merge_entries is not None:
+                    # Batched fold: flatten the whole drained queue, then
+                    # vectorized scatter-applies (ArrayShadowGraph).
+                    merge_entries(batch)
+                else:
+                    for entry in batch:
+                        self.shadow_graph.merge_entry(entry)
+                for entry in batch:
+                    entry.clean()
+                    pool.append(entry)
             if multi and self.delta_graph.non_empty():
                 self.finalize_delta_graph()
             ev.fields["num_entries"] = count

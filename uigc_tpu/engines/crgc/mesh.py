@@ -82,6 +82,7 @@ class MeshShadowGraph(ArrayShadowGraph):
         self.n_devices = n_devices
         self.mesh = sharded_trace.build_mesh(n_devices)
         self._trace_fn = sharded_trace.make_sharded_trace(self.mesh)
+        self._fold_fn = sharded_trace.make_sharded_fold(self.mesh, donate=True)
         self._node_log = set()  # enable dirty-slot tracking in the base
 
         # device state (built lazily on first trace)
@@ -173,6 +174,11 @@ class MeshShadowGraph(ArrayShadowGraph):
         self._dev_recv = jax.device_put(recv, nodes_s)
         self._dev_psrc = jax.device_put(self._pb_src, pairs_s)
         self._dev_pdst = jax.device_put(self._pb_dst, pairs_s)
+        # Host mirror of the last recv values synced to the device: the
+        # sharded fold applies *deltas* (reference: ShadowGraph.java:75-83
+        # folds counts, not absolutes), so per-wake sync needs the diff
+        # against what the device already holds.
+        self._recv_synced = recv.copy()
 
         self._pair_log = []
         self._node_log = set()
@@ -287,28 +293,40 @@ class MeshShadowGraph(ArrayShadowGraph):
             )
 
         if self._node_log:
-            slots_list = list(self._node_log)
+            slots_arr = np.fromiter(
+                self._node_log, np.int64, len(self._node_log)
+            )
             self._node_log = set()
-            k = len(slots_list)
-            kp = max(_SINK_PAD, _pow2(k))
-            slots = np.full(kp, self._n_pad, dtype=np.int32)  # OOB -> drop
-            slots[:k] = slots_list
-            fvals = np.zeros(kp, dtype=np.uint8)
-            rvals = np.zeros(kp, dtype=np.int64)
-            fvals[:k] = self.flags[slots_list]
-            rvals[:k] = self.recv_count[slots_list]
-
-            def build_nodes():
-                @partial(jax.jit, donate_argnums=(0, 1))
-                def apply_nodes(flags, recv, slots, fvals, rvals):
-                    flags = flags.at[slots].set(fvals, mode="drop")
-                    recv = recv.at[slots].set(rvals, mode="drop")
-                    return flags, recv
-
-                return apply_nodes
-
-            self._dev_flags, self._dev_recv = self._jit("nodes", build_nodes)(
-                self._dev_flags, self._dev_recv, slots, fvals, rvals
+            # Bucket dirty slots by owning shard and run the sharded fold
+            # (parallel/sharded_trace.make_sharded_fold): each device
+            # scatter-applies only its own shard's rows — recv as deltas
+            # against the synced mirror, flags as set/clear masks that
+            # reproduce absolute assignment ((old | set) & ~clear = new).
+            D = self.n_devices
+            ss = self._shard_size
+            shard = slots_arr // ss
+            order = np.argsort(shard, kind="stable")
+            slots_arr = slots_arr[order]
+            shard = shard[order]
+            counts = np.bincount(shard, minlength=D).astype(np.int64)
+            m = max(_SINK_PAD, _pow2(int(counts.max(initial=1))))
+            # per-shard local slot buckets, padded with the sink (= ss)
+            lslot = np.full((D, m), ss, dtype=np.int32)
+            rdelta = np.zeros((D, m), dtype=np.int64)
+            fset = np.zeros((D, m), dtype=np.uint8)
+            fclear = np.zeros((D, m), dtype=np.uint8)
+            starts = np.zeros(D, dtype=np.int64)
+            starts[1:] = np.cumsum(counts)[:-1]
+            col = np.arange(slots_arr.size, dtype=np.int64) - starts[shard]
+            new_flags = self.flags[slots_arr]
+            new_recv = self.recv_count[slots_arr]
+            lslot[shard, col] = (slots_arr - shard * ss).astype(np.int32)
+            rdelta[shard, col] = new_recv - self._recv_synced[slots_arr]
+            fset[shard, col] = new_flags
+            fclear[shard, col] = ~new_flags
+            self._recv_synced[slots_arr] = new_recv
+            self._dev_flags, self._dev_recv = self._fold_fn(
+                self._dev_flags, self._dev_recv, lslot, rdelta, fset, fclear
             )
 
     # ------------------------------------------------------------- #

@@ -189,7 +189,7 @@ def make_sharded_trace(mesh, axis: str = "gc"):
     return traced
 
 
-def make_sharded_fold(mesh, axis: str = "gc"):
+def make_sharded_fold(mesh, axis: str = "gc", donate: bool = False):
     """Build the jitted multi-device fold step: scatter a batch of entry
     deltas (recv-count deltas + flag overwrites, bucketed by node shard on
     host) into the sharded node arrays.  The device-side analogue of
@@ -200,7 +200,11 @@ def make_sharded_fold(mesh, axis: str = "gc"):
     recv deltas, keep the last flag set/clear pair), because the flag
     scatter reads the pre-batch value once and duplicate-index scatter
     order is undefined.  recv uses `.at[].add` and would compose, but the
-    flag path would not."""
+    flag path would not.
+
+    ``donate=True`` donates the flags/recv buffers so a steady-state
+    caller (the live mesh backend, per wake) updates its device arrays in
+    place instead of copying the whole sharded state per fold."""
     jax, jnp = _jax()
     try:
         from jax import shard_map
@@ -230,7 +234,7 @@ def make_sharded_fold(mesh, axis: str = "gc"):
         out_specs=(P(axis, None), P(axis, None)),
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def fold(flags, recv, slot, recv_delta, flag_set, flag_clear):
         f2, r2 = fn(flags, recv, slot, recv_delta, flag_set, flag_clear)
         return f2.reshape(-1), r2.reshape(-1)

@@ -30,6 +30,18 @@ def is_tpu_request(env: str | None) -> bool:
     return any(p in low for p in TPU_PLATFORMS)
 
 
+def env_flag(name: str) -> bool:
+    """Shared truthiness convention for the strict-mode env knobs
+    (``UIGC_BENCH_STRICT_PLATFORM``, ``UIGC_MULTICHIP_STRICT``): any
+    non-empty value except "0"/"false"/"no" enables."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "",
+        "0",
+        "false",
+        "no",
+    )
+
+
 def apply_platform_override(default: str | None = None) -> None:
     """Apply ``JAX_PLATFORMS`` (or ``default`` when unset/empty) through
     the config API.  An explicit TPU request is honored as-is."""
