@@ -27,6 +27,15 @@ from conftest import NATIVE_BACKEND
 
 BACKENDS = ["array", "mesh", NATIVE_BACKEND]
 
+#: link modes: "direct" = in-process objects, synchronous lockstep links;
+#: "wire" = every message serialized to bytes (object identity destroyed)
+#: over async FIFO links with window-id-matched ingress finalization.
+WIRE_MODES = ["direct", "wire"]
+
+
+def make_fabric(wire_mode):
+    return Fabric(serialize=wire_mode == "wire", async_links=wire_mode == "wire")
+
 
 def make_system(name, fabric, num_nodes, backend="array"):
     config = dict(BASE)
@@ -107,9 +116,10 @@ class Root(AbstractBehavior):
         return self
 
 
+@pytest.mark.parametrize("wire_mode", WIRE_MODES)
 @pytest.mark.parametrize("backend", BACKENDS)
-def test_two_node_remote_spawn_and_collect(backend):
-    fabric = Fabric()
+def test_two_node_remote_spawn_and_collect(backend, wire_mode):
+    fabric = make_fabric(wire_mode)
     sys_a = make_system("nodeA", fabric, 2, backend)
     sys_b = make_system("nodeB", fabric, 2, backend)
     try:
@@ -169,14 +179,15 @@ class Owner(AbstractBehavior):
         return self
 
 
+@pytest.mark.parametrize("wire_mode", WIRE_MODES)
 @pytest.mark.parametrize("backend", BACKENDS)
 @pytest.mark.parametrize("with_drops", [False, True], ids=["clean", "drops"])
-def test_three_node_crash_recovery(with_drops, backend):
+def test_three_node_crash_recovery(with_drops, backend, wire_mode):
     """A worker on B is kept alive solely by a ref held on C.  C crashes;
     the undo-log quorum reverts C's claims and the worker is collected.
     With drops injected on the C->B link, admitted counts diverge from
     claims — exactly what the ingress-entry machinery reconciles."""
-    fabric = Fabric()
+    fabric = make_fabric(wire_mode)
     sys_a = make_system("cnodeA", fabric, 3, backend)
     sys_b = make_system("cnodeB", fabric, 3, backend)
     sys_c = make_system("cnodeC", fabric, 3, backend)
@@ -220,13 +231,14 @@ def test_three_node_crash_recovery(with_drops, backend):
         sys_c.terminate()
 
 
+@pytest.mark.parametrize("wire_mode", WIRE_MODES)
 @pytest.mark.parametrize("backend", BACKENDS)
-def test_double_crash_quorum_recheck(backend):
+def test_double_crash_quorum_recheck(backend, wire_mode):
     """If a second node dies before delivering its final ingress entry
     for the first dead node, the shrunken quorum must be re-evaluated on
     membership change — otherwise the first node's undo log never folds
     and its actors leak as eternal pseudoroots."""
-    fabric = Fabric()
+    fabric = make_fabric(wire_mode)
     sys_a = make_system("dcA", fabric, 3, backend)
     sys_b = make_system("dcB", fabric, 3, backend)
     sys_c = make_system("dcC", fabric, 3, backend)

@@ -47,3 +47,85 @@ def test_sharded_matches_host(graph):
     # And the generator's intended garbage is exactly the unmarked in-use set.
     in_use = (graph["flags"] & trace_ops.FLAG_IN_USE) != 0
     assert np.array_equal(in_use & ~mark_host, graph["expected_garbage"])
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sharded_pallas_matches_host(seed):
+    """The per-shard Pallas layout plane (packed base + insert buckets)
+    must agree with the host oracle on the virtual mesh."""
+    import jax
+
+    from uigc_tpu.ops import pallas_incremental as pinc
+    from uigc_tpu.parallel import make_sharded_pallas_trace, pack_shard_layouts
+
+    n_devices = min(8, len(jax.devices()))
+    s_rows = 8  # 1024-node supertiles: shards span several at this n
+    rng = np.random.default_rng(seed)
+    graph = powerlaw_actor_graph(20_000, seed=seed, garbage_fraction=0.4)
+    n = graph["flags"].shape[0]
+    mark_host = trace_ops.trace_marks_np(
+        graph["flags"],
+        graph["recv_count"],
+        graph["supervisor"],
+        graph["edge_src"],
+        graph["edge_dst"],
+        graph["edge_weight"],
+    )
+
+    super_sz = s_rows * 128
+    chunk = n_devices * super_sz
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    flags = np.zeros(n_pad, np.uint8)
+    flags[:n] = graph["flags"]
+    recv = np.zeros(n_pad, np.int64)
+    recv[:n] = graph["recv_count"]
+
+    psrc, pdst, kinds = pinc.IncrementalPallasLayout.pairs_from_graph(
+        graph["edge_src"], graph["edge_dst"], graph["edge_weight"],
+        graph["supervisor"],
+    )
+    # hold back a slice of pairs as "inserts" riding the bucket tier
+    cut = psrc.size // 10
+    order = rng.permutation(psrc.size)
+    base_idx, ins_idx = order[cut:], order[:cut]
+
+    stacked, meta, slot_vals = pack_shard_layouts(
+        psrc[base_idx], pdst[base_idx], n_pad, n_devices, s_rows=s_rows
+    )
+
+    shard_size = meta["shard_size"]
+    owner = pdst[ins_idx] // shard_size
+    counts = np.bincount(owner, minlength=n_devices)
+    m = max(64, int(counts.max(initial=1)))
+    bsrc = np.full((n_devices, m), n_pad, np.int32)
+    bdst = np.zeros((n_devices, m), np.int32)
+    starts = np.zeros(n_devices, np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    so = np.argsort(owner, kind="stable")
+    col = np.arange(ins_idx.size) - starts[owner[so]]
+    bsrc[owner[so], col] = psrc[ins_idx][so]
+    bdst[owner[so], col] = (pdst[ins_idx][so] - owner[so] * shard_size)
+
+    mesh = build_mesh(n_devices)
+    traced = make_sharded_pallas_trace(
+        mesh,
+        meta["n_pad"],
+        shard_size,
+        meta["n_blocks"],
+        meta["r_rows"],
+        s_rows,
+        m,
+    )
+    mark = np.asarray(
+        traced(
+            flags,
+            recv,
+            stacked["bmeta1"],
+            stacked["bmeta2"],
+            stacked["row_pos"],
+            stacked["emeta"],
+            bsrc,
+            bdst,
+        )
+    )[:n]
+    assert np.array_equal(mark, mark_host)

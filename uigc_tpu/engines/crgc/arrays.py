@@ -643,9 +643,19 @@ class ArrayShadowGraph:
             live = w[eids] > 0
             self._log_pairs_batch(False, srcs[live], dsts[live], _PAIR_EDGE)
             eo = self.edge_of
-            for k in ((srcs.astype(np.int64) << 32) | dsts).tolist():
-                eo.pop(k, None)
-            w[eids] = 0
+            if eids.size * 2 > len(eo):
+                # Most edges die: rebuild the key map from the survivors
+                # in one pass instead of popping each dead key.
+                w[eids] = 0
+                alive = np.nonzero(w != 0)[0]
+                keys = (self.edge_src[alive].astype(np.int64) << 32) | (
+                    self.edge_dst[alive]
+                )
+                self.edge_of = dict(zip(keys.tolist(), alive.tolist()))
+            else:
+                for k in ((srcs.astype(np.int64) << 32) | dsts).tolist():
+                    eo.pop(k, None)
+                w[eids] = 0
             self.free_edges.extend(eids.tolist())
 
         sup = self.supervisor[garbage_slots]

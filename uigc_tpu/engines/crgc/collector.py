@@ -198,12 +198,10 @@ class Bookkeeper(RawBehavior):
         self.downed_gcs.add(address)
         self.remote_gcs.pop(address, None)
         # Finalize the ingress for the dead link (the NewIngressActor hook
-        # in the reference, Gateways.scala:129).
+        # in the reference, Gateways.scala:129).  In async-link mode the
+        # final entry rides the link queue behind any in-flight traffic.
         fabric = self.engine.system.fabric
-        for link in fabric.ingress_links_to(self.engine.system):
-            if link.src.address == address and link.ingress is not None:
-                with link.lock:
-                    link.ingress.finalize_and_send(is_final=True)
+        fabric.finalize_dead_link(address, self.engine.system)
         # Membership shrank, so quorums that were waiting on the removed
         # node may now be satisfiable — re-check every pending undo log.
         # (The reference only checks on is_final arrival,

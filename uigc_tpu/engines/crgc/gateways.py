@@ -187,23 +187,69 @@ class Egress(Gateway):
             msg.window_id = self.current_entry.id
 
 
-class Ingress(Gateway):
+class Ingress:
     """Receiver-side interceptor; finalized entries go to the local
-    collector (reference: Gateways.scala:121-141)."""
+    collector (reference: Gateways.scala:121-141).
+
+    Admitted tallies are bucketed *by the window id stamped on each
+    message*, and a window closes when the egress's boundary marker for
+    that id arrives (reference: Gateways.scala:83-94,168-171 finalizes
+    the entry matching the in-stream marker).  Next-window messages that
+    overtake a marker's processing therefore land in their own entry
+    instead of corrupting the closing one — the property that makes the
+    async link mode sound."""
 
     def __init__(self, link: "Link", engine: "CRGC"):
-        super().__init__(link.src.address, link.dst.address)
+        self.egress_address = link.src.address
+        self.ingress_address = link.dst.address
         self.engine = engine
+        self.entries: Dict[int, IngressEntry] = {}
+        self._max_window = -1
+
+    def _make_entry(self, window_id: int) -> IngressEntry:
+        entry = IngressEntry()
+        entry.id = window_id
+        entry.egress_address = self.egress_address
+        entry.ingress_address = self.ingress_address
+        return entry
 
     def on_message(self, recipient: "ActorCell", msg: Any) -> None:
         if isinstance(msg, AppMsg):
-            self.current_entry.on_message(recipient, msg.refs)
+            wid = msg.window_id
+            self._max_window = max(self._max_window, wid)
+            entry = self.entries.get(wid)
+            if entry is None:
+                entry = self.entries[wid] = self._make_entry(wid)
+            entry.on_message(recipient, msg.refs)
 
-    def finalize_and_send(self, is_final: bool = False) -> None:
-        """(reference: Gateways.scala:131-141)"""
+    def _send(self, entry: IngressEntry) -> None:
         from .collector import LocalIngressEntry
 
-        entry = self.finalize_entry()
+        self.engine.bookkeeper_cell.tell(LocalIngressEntry(entry))
+
+    def finalize_window(self, window_id: int, is_final: bool = False) -> None:
+        """Close the window the egress marker names (empty entries are
+        emitted too — the collector's undo log needs the window sequence
+        even when no traffic was admitted)."""
+        self._max_window = max(self._max_window, window_id)
+        entry = self.entries.pop(window_id, None)
+        if entry is None:
+            entry = self._make_entry(window_id)
         if is_final:
             entry.is_final = True
-        self.engine.bookkeeper_cell.tell(LocalIngressEntry(entry))
+        self._send(entry)
+
+    def finalize_all(self, is_final: bool = False) -> None:
+        """Link death: flush every open window in order, then emit the
+        final (possibly empty) entry that joins the crash quorum
+        (reference: Gateways.scala:129, LocalGC.scala:251-266)."""
+        for wid in sorted(self.entries):
+            entry = self.entries.pop(wid)
+            self._send(entry)
+        final_entry = self._make_entry(self._max_window + 1)
+        final_entry.is_final = is_final
+        self._send(final_entry)
+
+    # Compatibility shim for the lockstep call shape (single window).
+    def finalize_and_send(self, is_final: bool = False) -> None:
+        self.finalize_all(is_final=is_final)

@@ -81,31 +81,40 @@ class MeshShadowGraph(ArrayShadowGraph):
         )
         self.n_devices = n_devices
         self.mesh = sharded_trace.build_mesh(n_devices)
-        self._trace_fn = sharded_trace.make_sharded_trace(self.mesh)
         self._fold_fn = sharded_trace.make_sharded_fold(self.mesh, donate=True)
+        self._mask_fn = sharded_trace.make_sharded_mask(self.mesh)
         self._node_log = set()  # enable dirty-slot tracking in the base
+
+        from ...ops import pallas_trace as pt
+
+        self.s_rows = pt.S_ROWS
 
         # device state (built lazily on first trace)
         self._dev_ready = False
         self._dev_flags = None
         self._dev_recv = None
-        self._dev_psrc = None
-        self._dev_pdst = None
         self._n_pad = 0
         self._shard_size = 0
-        # host mirror of the pair buckets
+        # --- packed base plane: per-shard Pallas layouts -------------- #
+        self._layout_meta: Optional[dict] = None
+        self._stacked: Optional[dict] = None  # host truth of the layouts
+        self._dev_stacked: Optional[dict] = None
+        #: packed (src, dst, kind) key -> (shard << 40 | ri << 8 | col)
+        self._base_slot = PackedSlotMap()
+        #: queued deletion masks for the device layouts [(shard, ri, col)]
+        self._mask_writes: List[Tuple[int, int, int]] = []
+        # --- insert buckets: XLA scatter-max tier for new pairs ------- #
         self._bucket_m = 0  # columns per shard (pow2)
         self._pb_src: Optional[np.ndarray] = None  # [D, M] global src ids
         self._pb_dst: Optional[np.ndarray] = None  # [D, M] local dst ids
         self._pb_count: Optional[np.ndarray] = None
         self._pb_free: List[List[int]] = []
-        #: packed (src, dst, kind) key -> packed (shard << 32 | column);
-        #: sorted numpy bulk + churn overlays (ops/slotmap.py) so rebuild
-        #: stays vectorized instead of one Python dict entry per pair
+        #: packed (src, dst, kind) key -> packed (shard << 32 | column)
         self._pb_slot = PackedSlotMap()
         self.stats = {"rebuilds": 0, "wakes": 0, "anomalies": 0}
 
         self._jit_cache: Dict[str, object] = {}
+        self._trace_cache: Dict[tuple, object] = {}
 
     # ------------------------------------------------------------- #
     # Device state construction
@@ -118,6 +127,7 @@ class MeshShadowGraph(ArrayShadowGraph):
         return (
             NamedSharding(self.mesh, P("gc")),
             NamedSharding(self.mesh, P("gc", None)),
+            NamedSharding(self.mesh, P("gc", None, None)),
         )
 
     def _full_rebuild(self) -> None:
@@ -125,53 +135,54 @@ class MeshShadowGraph(ArrayShadowGraph):
 
         self.stats["rebuilds"] += 1
         D = self.n_devices
-        n_pad = ((self.capacity + D - 1) // D) * D
+        super_sz = self.s_rows * 128
+        chunk = D * super_sz
+        n_pad = ((self.capacity + chunk - 1) // chunk) * chunk
         self._n_pad = n_pad
         self._shard_size = n_pad // D
 
-        # --- pair buckets from the host truth --------------------- #
+        # --- packed base layouts from the host truth -------------- #
         from ...ops.pallas_incremental import IncrementalPallasLayout
 
         esrc, edst, kinds = IncrementalPallasLayout.pairs_from_graph(
             self.edge_src, self.edge_dst, self.edge_weight, self.supervisor
         )
-
-        owner = edst // self._shard_size
-        order = np.argsort(owner, kind="stable")
-        esrc, edst, kinds, owner = (
-            esrc[order],
-            edst[order],
-            kinds[order],
-            owner[order],
+        stacked, meta, slot_vals = sharded_trace.pack_shard_layouts(
+            esrc, edst, n_pad, D, s_rows=self.s_rows
         )
-        counts = np.bincount(owner, minlength=D).astype(np.int64)
-        # 2x headroom so a bucket overflow doesn't rebuild into an
-        # already-full layout (rebuild storm)
-        m = _pow2(max(1024, 2 * int(counts.max(initial=0))))
+        self._stacked = stacked
+        self._layout_meta = meta
+        self._base_slot = PackedSlotMap(
+            pack_keys(esrc, edst, kinds), slot_vals
+        )
+        self._mask_writes = []
+
+        # --- empty insert buckets --------------------------------- #
+        # Sized so the bucket tier absorbs a meaningful fraction of the
+        # graph's scale in new pairs before the next rebuild folds them
+        # into the packed base (the freeze/consolidate analogue).
+        m = _pow2(max(1024, self.capacity // (4 * D)))
         self._bucket_m = m
         self._pb_src = np.full((D, m), self._n_pad, dtype=np.int32)
         self._pb_dst = np.zeros((D, m), dtype=np.int32)
-        self._pb_count = counts
+        self._pb_count = np.zeros(D, dtype=np.int64)
         self._pb_free = [[] for _ in range(D)]
-        # column of each (sorted) pair within its shard
-        starts = np.zeros(D, dtype=np.int64)
-        starts[1:] = np.cumsum(counts)[:-1]
-        col = np.arange(esrc.size, dtype=np.int64) - starts[owner]
-        self._pb_src[owner, col] = esrc
-        self._pb_dst[owner, col] = edst - owner * self._shard_size
-        self._pb_slot = PackedSlotMap(
-            pack_keys(esrc, edst, kinds),
-            (owner.astype(np.int64) << 32) | col,
-        )
+        self._pb_slot = PackedSlotMap()
 
         # --- device arrays ---------------------------------------- #
-        nodes_s, pairs_s = self._sharding()
+        nodes_s, pairs_s, pairs3_s = self._sharding()
         flags = np.zeros(n_pad, dtype=np.uint8)
         flags[: self.capacity] = self.flags
         recv = np.zeros(n_pad, dtype=np.int64)
         recv[: self.capacity] = self.recv_count
         self._dev_flags = jax.device_put(flags, nodes_s)
         self._dev_recv = jax.device_put(recv, nodes_s)
+        self._dev_stacked = {
+            "bmeta1": jax.device_put(stacked["bmeta1"], pairs_s),
+            "bmeta2": jax.device_put(stacked["bmeta2"], pairs_s),
+            "row_pos": jax.device_put(stacked["row_pos"], pairs3_s),
+            "emeta": jax.device_put(stacked["emeta"], pairs3_s),
+        }
         self._dev_psrc = jax.device_put(self._pb_src, pairs_s)
         self._dev_pdst = jax.device_put(self._pb_dst, pairs_s)
         # Host mirror of the last recv values synced to the device: the
@@ -189,30 +200,51 @@ class MeshShadowGraph(ArrayShadowGraph):
     # ------------------------------------------------------------- #
 
     def _apply_pair_log(self) -> Optional[list]:
-        """Fold pair transitions into the host buckets; returns the
-        device scatter batch, or None if the buckets overflowed (full
-        rebuild required).
+        """Fold pair transitions into the host plane; returns the bucket
+        device-scatter batch, or None if the buckets overflowed (full
+        rebuild required).  Deletions hitting the packed base mask its
+        slot in place (host + queued device mask); deletions hitting the
+        bucket free its column; inserts land in the bucket tier.
 
         Batched like IncrementalPallasLayout.apply_log (the net-effect
         argument and anomaly accounting live in slotmap.fold_log): slot
         lookups are one vectorized binary search per batch."""
         removes, cond_removes, inserts = fold_log(self._pair_log)
         writes: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        stacked = self._stacked
+
+        def mask_base(packed: int) -> None:
+            from ...ops import pallas_trace as pt
+
+            shard = packed >> 40
+            ri = (packed >> 8) & 0xFFFFFFFF
+            col = packed & 0xFF
+            stacked["row_pos"][shard, ri, col] = pt._PAD_ROW
+            stacked["emeta"][shard, ri, col] = 0
+            self._mask_writes.append((shard, ri, col))
 
         def free_slot_batch(keys: list, found_is_anomaly: bool) -> None:
-            vals = self._pb_slot.pop_batch(np.fromiter(keys, np.int64, len(keys)))
-            for packed in vals.tolist():
-                if packed < 0:
-                    if not found_is_anomaly:
+            karr = np.fromiter(keys, np.int64, len(keys))
+            bucket_vals = self._pb_slot.pop_batch(karr)
+            missing = bucket_vals < 0
+            base_vals = np.full(karr.size, -1, dtype=np.int64)
+            if missing.any():
+                base_vals[missing] = self._base_slot.pop_batch(karr[missing])
+            for bval, sval in zip(bucket_vals.tolist(), base_vals.tolist()):
+                if bval >= 0:
+                    if found_is_anomaly:
                         self.stats["anomalies"] += 1
-                    continue
-                if found_is_anomaly:
+                    shard, colm = bval >> 32, bval & 0xFFFFFFFF
+                    self._pb_src[shard, colm] = self._n_pad  # sink
+                    self._pb_dst[shard, colm] = 0
+                    self._pb_free[shard].append(colm)
+                    writes[(shard, colm)] = (self._n_pad, 0)
+                elif sval >= 0:
+                    if found_is_anomaly:
+                        self.stats["anomalies"] += 1
+                    mask_base(sval)
+                elif not found_is_anomaly:
                     self.stats["anomalies"] += 1
-                shard, colm = packed >> 32, packed & 0xFFFFFFFF
-                self._pb_src[shard, colm] = self._n_pad  # sink
-                self._pb_dst[shard, colm] = 0
-                self._pb_free[shard].append(colm)
-                writes[(shard, colm)] = (self._n_pad, 0)
 
         if removes:
             free_slot_batch(removes, found_is_anomaly=False)
@@ -223,7 +255,9 @@ class MeshShadowGraph(ArrayShadowGraph):
 
         if inserts:
             karr = np.fromiter(inserts, np.int64, len(inserts))
-            present = self._pb_slot.get_batch(karr) >= 0
+            present = (self._pb_slot.get_batch(karr) >= 0) | (
+                self._base_slot.get_batch(karr) >= 0
+            )
             srcs, dsts = unpack_keys(karr)
             for key, src, dst, dup in zip(
                 inserts, srcs.tolist(), dsts.tolist(), present.tolist()
@@ -292,6 +326,30 @@ class MeshShadowGraph(ArrayShadowGraph):
                 self._dev_psrc, self._dev_pdst, shs, cols, srcs, dsts
             )
 
+        if self._mask_writes:
+            # base-layout deletions: per-shard in-place masking
+            D = self.n_devices
+            rows_total = self._stacked["row_pos"].shape[1]
+            per_shard: List[List[Tuple[int, int]]] = [[] for _ in range(D)]
+            for shard, ri, colm in self._mask_writes:
+                per_shard[shard].append((ri, colm))
+            self._mask_writes = []
+            k = max(_SINK_PAD, _pow2(max(len(p) for p in per_shard)))
+            ri = np.full((D, k), rows_total, dtype=np.int32)  # OOB -> drop
+            col = np.zeros((D, k), dtype=np.int32)
+            for d in range(D):
+                for i, (r, c) in enumerate(per_shard[d]):
+                    ri[d, i] = r
+                    col[d, i] = c
+            self._dev_stacked["row_pos"], self._dev_stacked["emeta"] = (
+                self._mask_fn(
+                    self._dev_stacked["row_pos"],
+                    self._dev_stacked["emeta"],
+                    ri,
+                    col,
+                )
+            )
+
         if self._node_log:
             slots_arr = np.fromiter(
                 self._node_log, np.int64, len(self._node_log)
@@ -337,7 +395,28 @@ class MeshShadowGraph(ArrayShadowGraph):
         with events.recorder.timed(events.DEVICE_TRACE):
             self._sync_device()
             self.stats["wakes"] += 1
-            mark = self._trace_fn(
-                self._dev_flags, self._dev_recv, self._dev_psrc, self._dev_pdst
+            meta = self._layout_meta
+            key = (self._n_pad, meta["n_blocks"], self._bucket_m)
+            traced = self._trace_cache.get(key)
+            if traced is None:
+                traced = sharded_trace.make_sharded_pallas_trace(
+                    self.mesh,
+                    self._n_pad,
+                    self._shard_size,
+                    meta["n_blocks"],
+                    meta["r_rows"],
+                    self.s_rows,
+                    self._bucket_m,
+                )
+                self._trace_cache[key] = traced
+            mark = traced(
+                self._dev_flags,
+                self._dev_recv,
+                self._dev_stacked["bmeta1"],
+                self._dev_stacked["bmeta2"],
+                self._dev_stacked["row_pos"],
+                self._dev_stacked["emeta"],
+                self._dev_psrc,
+                self._dev_pdst,
             )
             return np.asarray(mark)[: self.capacity]

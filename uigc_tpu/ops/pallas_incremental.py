@@ -101,6 +101,16 @@ class IncrementalPallasLayout:
             "pack_s": 0.0,
             "anomalies": 0,
         }
+        #: device-resident mirrors (trace_device): mirror token -> dict of
+        #: device arrays; plus per-prep masked-slot write queues so the
+        #: mirror syncs in O(churn) instead of re-uploading the layout.
+        #: Tokens are monotonically assigned and stamped into the prep
+        #: dict — keying by id(prep) would serve a stale mirror when the
+        #: allocator recycles a freed dict's address.
+        self._dev_mirror: Dict[int, dict] = {}
+        self._dev_writes: Dict[int, List[int]] = {}
+        self._dev_scatter = None
+        self._mirror_next = 0
 
     # ----------------------------------------------------------------- #
     # Building
@@ -218,6 +228,15 @@ class IncrementalPallasLayout:
             return
         self.pending[key] = None
 
+    def _queue_dev_write(self, prep, ri, col) -> None:
+        """Record a masked slot for the device mirror (packed ri<<8|col)."""
+        tok = prep.get("_mirror_token")
+        if tok is None:
+            return
+        writes = self._dev_writes.get(tok)
+        if writes is not None:
+            writes.append((int(ri) << 8) | int(col))
+
     def remove(self, src: int, dst: int, kind: int) -> None:
         key = pack_key(src, dst, kind)
         if key in self.pending:
@@ -229,6 +248,7 @@ class IncrementalPallasLayout:
             prep = self.frozen[fidx]
             prep["row_pos"][ri, col] = pt._PAD_ROW
             prep["emeta"][ri, col] = 0
+            self._queue_dev_write(prep, ri, col)
             self.masked_frozen += 1
             return
         packed = self.base_slot.pop(key)
@@ -238,6 +258,7 @@ class IncrementalPallasLayout:
         ri, col = packed >> 8, packed & 0xFF
         self.base["row_pos"][ri, col] = pt._PAD_ROW
         self.base["emeta"][ri, col] = 0
+        self._queue_dev_write(self.base, ri, col)
         self.masked_base += 1
 
     def _mask_base_slots(self, vals: np.ndarray) -> int:
@@ -248,6 +269,10 @@ class IncrementalPallasLayout:
         col = vals[found] & 0xFF
         self.base["row_pos"][ri, col] = pt._PAD_ROW
         self.base["emeta"][ri, col] = 0
+        tok = self.base.get("_mirror_token")
+        writes = self._dev_writes.get(tok) if tok is not None else None
+        if writes is not None:
+            writes.extend(vals[found].tolist())
         n = int(found.sum())
         self.masked_base += n
         return n
@@ -264,6 +289,7 @@ class IncrementalPallasLayout:
             prep = self.frozen[fidx]
             prep["row_pos"][ri, col] = pt._PAD_ROW
             prep["emeta"][ri, col] = 0
+            self._queue_dev_write(prep, ri, col)
             self.masked_frozen += 1
             return True
         base_rem.append(k)
@@ -369,3 +395,96 @@ class IncrementalPallasLayout:
         return pt.trace_marks_layouts(
             flags, recv_count, preps, interpret=self.interpret
         )
+
+    # ----------------------------------------------------------------- #
+    # Device-resident trace (steady-state wake path on real hardware)
+    # ----------------------------------------------------------------- #
+
+    def _device_args(self, prep) -> list:
+        """Device operands for one layout, from a mirror that lives on
+        the device across wakes and syncs only the slots masked since the
+        last sync (an O(churn) scatter, not an O(layout) re-upload)."""
+        import jax
+
+        if "xla_src" in prep:
+            # the live tier is small and fully rebuilt per wake; let the
+            # call transfer it
+            return list(pt.device_args(prep))
+        pid = prep.get("_mirror_token")
+        if pid is None:
+            pid = prep["_mirror_token"] = self._mirror_next
+            self._mirror_next += 1
+        mirror = self._dev_mirror.get(pid)
+        if mirror is None:
+            mirror = {
+                k: jax.device_put(prep[k])
+                for k in ("bmeta1", "bmeta2", "row_pos", "emeta")
+            }
+            if "super_ids" in prep:
+                mirror["super_ids"] = jax.device_put(prep["super_ids"])
+            self._dev_mirror[pid] = mirror
+            self._dev_writes[pid] = []
+        else:
+            writes = self._dev_writes[pid]
+            if writes:
+                import jax.numpy as jnp
+                from functools import partial
+
+                if self._dev_scatter is None:
+
+                    @partial(jax.jit, donate_argnums=(0, 1))
+                    def _scatter(row_pos, emeta, rows, cols):
+                        row_pos = row_pos.at[rows, cols].set(
+                            pt._PAD_ROW, mode="drop"
+                        )
+                        emeta = emeta.at[rows, cols].set(0, mode="drop")
+                        return row_pos, emeta
+
+                    self._dev_scatter = _scatter
+                k = len(writes)
+                kp = 1 << max(6, int(k - 1).bit_length())
+                packed = np.fromiter(writes, np.int64, k)
+                rows = np.full(kp, prep["row_pos"].shape[0], dtype=np.int32)
+                cols = np.zeros(kp, dtype=np.int32)
+                rows[:k] = packed >> 8
+                cols[:k] = packed & 0xFF
+                mirror["row_pos"], mirror["emeta"] = self._dev_scatter(
+                    mirror["row_pos"], mirror["emeta"], rows, cols
+                )
+                writes.clear()
+        out = [
+            mirror["bmeta1"],
+            mirror["bmeta2"],
+            mirror["row_pos"],
+            mirror["emeta"],
+        ]
+        if "super_ids" in prep:
+            out.append(mirror["super_ids"])
+        return out
+
+    def trace_device(self, flags_dev, recv_dev):
+        """Like :meth:`trace`, but every packed layout's operand arrays
+        stay device-resident between wakes (the reference's steady state:
+        LocalGC.scala:144-186 never re-ships its graph per wake) and the
+        mark vector is returned as a device array, so callers can reduce
+        garbage counts/ids on device instead of pulling 10M bools."""
+        preps = self.prepare_wake()
+        fn = pt.get_trace_fn_multi(
+            self.n,
+            tuple(pt.layout_spec(p) for p in preps),
+            preps[0]["n_super"],
+            preps[0]["r_rows"],
+            preps[0]["s_rows"],
+            self.interpret,
+        )
+        args = []
+        for p in preps:
+            args.extend(self._device_args(p))
+        live_tokens = {
+            p["_mirror_token"] for p in preps if "_mirror_token" in p
+        }
+        for pid in list(self._dev_mirror):
+            if pid not in live_tokens:
+                del self._dev_mirror[pid]
+                self._dev_writes.pop(pid, None)
+        return fn(flags_dev, recv_dev, *args)

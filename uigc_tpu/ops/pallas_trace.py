@@ -75,6 +75,19 @@ S_ROWS = 32
 # Sentinel row for empty slots: beyond any table chunk, so they never hit.
 _PAD_ROW = np.int32(1 << 28)
 _SPAN_BITS = 12  # chunk index / span fit in 12 bits up to ~134M actors
+#: quantum for large-layout block padding (see _pad_blocks_target)
+_BLOCK_QUANTUM = 8192
+
+
+def _pad_blocks_target(n_blocks: int) -> int:
+    """Padded block count for a mutable layout: power of two while small
+    (maximum kernel-cache reuse), then multiples of ``_BLOCK_QUANTUM``.
+    Block metadata is scalar-prefetched into SMEM (1 MB): pow2 padding of
+    a ~90k-block layout would waste ~350 KB of it and OOM the 10M-actor
+    graph, while quantum padding stays within budget up to ~60M actors."""
+    if n_blocks <= _BLOCK_QUANTUM:
+        return 1 << max(0, int(n_blocks - 1).bit_length())
+    return ((n_blocks + _BLOCK_QUANTUM - 1) // _BLOCK_QUANTUM) * _BLOCK_QUANTUM
 
 
 def prepare_chunks(
@@ -119,6 +132,7 @@ def prepare_pairs(
     pad_blocks_pow2: bool = False,
     want_slots: bool = False,
     compact_supers: bool = False,
+    n_src: int = None,
 ) -> Dict[str, np.ndarray]:
     """Pack explicit propagation pairs (already filtered to live ones)
     into kernel blocks.
@@ -133,7 +147,13 @@ def prepare_pairs(
     (k_touched * s_rows, LANE) and ``super_ids`` maps each compact tile
     back to its global supertile.  Without it, a tiny delta layout over
     a 10M-node space would still pay one (mostly dummy) grid step per
-    global supertile; with it the cost scales with the delta."""
+    global supertile; with it the cost scales with the delta.
+
+    ``n_src`` decouples the source space from the destination space: the
+    bit-table geometry (r_rows) covers ``n_src`` nodes while supertiles
+    cover ``n`` destinations.  The mesh path uses this — sources are
+    global ids gathered from the all-gathered table, destinations are
+    shard-local (parallel/sharded_trace)."""
     assert 1 <= s_rows <= 32, "dst_sub is packed in 5 bits"
     super_sz = s_rows * LANE
     psrc = np.asarray(psrc, dtype=np.int64)
@@ -142,7 +162,7 @@ def prepare_pairs(
     n_super = max(1, -(-n // super_sz))
     n_pad = n_super * super_sz
     # Bit table geometry: R rows of 128 lanes of 32-bit words.
-    n_words = -(-n_pad // WORD_BITS)
+    n_words = -(-(n_src if n_src is not None else n_pad) // WORD_BITS)
     r_rows = -(-n_words // LANE)
     r_rows = ((r_rows + ROWS - 1) // ROWS) * ROWS  # multiple of 8
     assert r_rows // ROWS < (1 << _SPAN_BITS), "graph too large for span packing"
@@ -267,7 +287,7 @@ def prepare_pairs(
             n_tiles = k_pad
 
     if pad_blocks_pow2:
-        padded = 1 << max(0, int(n_blocks - 1).bit_length())
+        padded = _pad_blocks_target(n_blocks)
         if padded > n_blocks:
             extra = padded - n_blocks
             # Inert blocks: span 0 (no gather), accumulate zeros into the
@@ -319,6 +339,29 @@ def prepare_pairs(
             slot_col if slot_col is not None else np.zeros(0, dtype=np.int64)
         )
     return prep
+
+
+def pad_layout_blocks(prep: Dict[str, np.ndarray], target: int) -> None:
+    """Pad a packed layout with inert blocks (span 0, not first-visit,
+    accumulating nothing into the last supertile) up to ``target`` blocks,
+    in place.  The mesh path uses this to equalize per-shard block counts
+    so one SPMD program covers every shard."""
+    extra = target - prep["n_blocks"]
+    if extra <= 0:
+        return
+    n_tiles = prep.get("out_supers", prep["n_super"])
+    bmeta1_pad = np.full(extra, (n_tiles - 1) << 1, dtype=np.int32)
+    prep["bmeta1"] = np.concatenate([prep["bmeta1"], bmeta1_pad])
+    prep["bmeta2"] = np.concatenate(
+        [prep["bmeta2"], np.zeros(extra, dtype=np.int32)]
+    )
+    prep["row_pos"] = np.concatenate(
+        [prep["row_pos"], np.full((extra * ROWS, LANE), _PAD_ROW, np.int32)]
+    )
+    prep["emeta"] = np.concatenate(
+        [prep["emeta"], np.zeros((extra * ROWS, LANE), np.int32)]
+    )
+    prep["n_blocks"] = target
 
 
 def device_args(prep: Dict[str, np.ndarray]) -> tuple:

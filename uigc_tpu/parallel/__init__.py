@@ -1,8 +1,17 @@
 from .sharded_trace import (
     build_mesh,
     make_sharded_fold,
+    make_sharded_pallas_trace,
     make_sharded_trace,
+    pack_shard_layouts,
     shard_graph,
 )
 
-__all__ = ["build_mesh", "make_sharded_fold", "make_sharded_trace", "shard_graph"]
+__all__ = [
+    "build_mesh",
+    "make_sharded_fold",
+    "make_sharded_pallas_trace",
+    "make_sharded_trace",
+    "pack_shard_layouts",
+    "shard_graph",
+]

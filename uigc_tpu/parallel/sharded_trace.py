@@ -189,6 +189,293 @@ def make_sharded_trace(mesh, axis: str = "gc"):
     return traced
 
 
+def pack_shard_layouts(
+    psrc: np.ndarray,
+    pdst: np.ndarray,
+    n_pad: int,
+    n_devices: int,
+    s_rows: int = None,
+):
+    """Pack propagation pairs into one Pallas layout per destination
+    shard, equalized to a common block count and stacked on a leading
+    device axis (SPMD: every shard runs the same program over its own
+    blocks).
+
+    Sources stay *global* ids — the kernel gathers them from the
+    all-gathered packed bit table — while destinations are shard-local,
+    so each device's one-hot contraction lands only in its own node
+    shard (prepare_pairs ``n_src`` mode).
+
+    Returns (stacked, meta, slot_vals): ``stacked`` holds [D, ...] arrays
+    (bmeta1, bmeta2, row_pos, emeta); ``slot_vals`` gives each input
+    pair's packed (shard << 40 | ri << 8 | col) slot for in-place
+    deletion masking, aligned with the input pair order."""
+    from ..ops import pallas_trace as pt
+
+    if s_rows is None:
+        s_rows = pt.S_ROWS
+    super_sz = s_rows * pt.LANE
+    shard_size = n_pad // n_devices
+    assert n_pad % n_devices == 0 and shard_size % super_sz == 0, (
+        "n_pad must split into shards of whole supertiles"
+    )
+    psrc = np.asarray(psrc, dtype=np.int64)
+    pdst = np.asarray(pdst, dtype=np.int64)
+    owner = pdst // shard_size
+
+    preps = []
+    slot_vals = np.empty(psrc.size, dtype=np.int64)
+    for d in range(n_devices):
+        sel = np.nonzero(owner == d)[0]
+        prep = pt.prepare_pairs(
+            psrc[sel],
+            pdst[sel] - d * shard_size,
+            shard_size,
+            s_rows=s_rows,
+            want_slots=True,
+            n_src=n_pad,
+        )
+        slot_ri = prep.pop("slot_ri")
+        slot_col = prep.pop("slot_col")
+        slot_vals[sel] = (d << 40) | (slot_ri << 8) | slot_col
+        preps.append(prep)
+
+    n_blocks = pt._pad_blocks_target(max(p["n_blocks"] for p in preps))
+    for p in preps:
+        pt.pad_layout_blocks(p, n_blocks)
+
+    stacked = {
+        "bmeta1": np.stack([p["bmeta1"] for p in preps]),
+        "bmeta2": np.stack([p["bmeta2"] for p in preps]),
+        "row_pos": np.stack([p["row_pos"] for p in preps]),
+        "emeta": np.stack([p["emeta"] for p in preps]),
+    }
+    meta = {
+        "n_pad": n_pad,
+        "shard_size": shard_size,
+        "n_blocks": n_blocks,
+        "r_rows": preps[0]["r_rows"],
+        "s_rows": s_rows,
+    }
+    return stacked, meta, slot_vals
+
+
+def make_sharded_pallas_trace(
+    mesh,
+    n_pad: int,
+    shard_size: int,
+    n_blocks: int,
+    r_rows: int,
+    s_rows: int,
+    bucket_m: int,
+    interpret: bool = None,
+    axis: str = "gc",
+):
+    """The mesh trace with the Pallas propagation kernel per shard.
+
+    Per fixpoint wave each device packs its local active bits into words,
+    ``all_gather``s the packed table over ICI (32x less traffic than
+    gathering bools), runs the propagation kernel over its own packed
+    blocks with the dirty-chunk lists, and adds an XLA scatter-max tier
+    for its insert bucket ([1, bucket_m] per shard, global src ids, local
+    dst).  The dirty-chunk diff is computed on the *global* table, so the
+    convergence decision is replicated — no psum needed.
+
+    fn(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst) -> mark
+    with flags/recv sharded by node range, the rest sharded on their
+    leading device axis.
+    """
+    jax, jnp = _jax()
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import pallas_trace as pt
+    from ..ops import trace as F
+
+    if interpret is None:
+        interpret = pt.default_interpret()
+    super_sz = s_rows * pt.LANE
+    n_super_shard = shard_size // super_sz
+    propagate = pt.build_propagate(
+        n_blocks, n_super_shard, r_rows, s_rows, interpret
+    )
+    n_chunks = r_rows // pt.ROWS
+    shard_words = shard_size // pt.WORD_BITS
+    words_pad = r_rows * pt.LANE
+
+    def local_trace(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
+        flags = flags.reshape(-1)
+        recv = recv.reshape(-1)
+        bmeta1 = bmeta1.reshape(-1)
+        bmeta2 = bmeta2.reshape(-1)
+        row_pos = row_pos.reshape(-1, pt.LANE)
+        emeta = emeta.reshape(-1, pt.LANE)
+        bsrc = bsrc.reshape(-1)
+        bdst = bdst.reshape(-1)
+
+        in_use = (flags & F.FLAG_IN_USE) != 0
+        halted = (flags & F.FLAG_HALTED) != 0
+        seed = (
+            ((flags & F.FLAG_ROOT) != 0)
+            | ((flags & F.FLAG_BUSY) != 0)
+            | (recv != 0)
+            | ((flags & F.FLAG_INTERNED) == 0)
+        )
+        mark0 = in_use & (~halted) & seed
+
+        shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
+        chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+
+        def pack_table(local_active):
+            w = (
+                local_active.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
+                << shifts[None, :]
+            ).sum(axis=1, dtype=jnp.int32)
+            w_all = jax.lax.all_gather(w, axis).reshape(-1)
+            w_all = jnp.concatenate(
+                [w_all, jnp.zeros((words_pad - w_all.shape[0],), jnp.int32)]
+            )
+            return w_all.reshape(r_rows, pt.LANE)
+
+        def dirty_chunks(table, table_prev):
+            diff = (
+                (table != table_prev)
+                .reshape(n_chunks, pt.ROWS * pt.LANE)
+                .any(axis=1)
+            )
+            counts = diff.astype(jnp.int32)
+            d = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
+            )
+            pos = jnp.where(diff, d[:-1], n_chunks)
+            l = (
+                jnp.zeros((n_chunks + 1,), jnp.int32)
+                .at[pos]
+                .set(chunk_ids)[:n_chunks]
+            )
+            return d, l, d[n_chunks] > 0
+
+        def src_bits(table, src):
+            """Gather global source active bits from the packed table.
+            Bucket padding uses src = n_pad (the sink): mask it out
+            explicitly rather than trusting the clamped gather."""
+            word = src >> 5
+            w = table[word >> 7, word & 127]
+            return (((w >> (src & 31)) & 1) > 0) & (src < n_pad)
+
+        def cond(carry):
+            return carry[-1]
+
+        def body(carry):
+            mark, table, d, l, _ = carry
+            contrib = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
+            hits = contrib.reshape(-1)[:shard_size] > 0
+            # insert-bucket tier: global src gather, local scatter-max
+            src_active = src_bits(table, bsrc)
+            prop = (
+                jnp.zeros((shard_size + 1,), jnp.int32)
+                .at[bdst]
+                .max(src_active.astype(jnp.int32))
+            )
+            hits = hits | (prop[:shard_size] > 0)
+            new_mark = mark | (hits & in_use)
+            new_table = pack_table(new_mark & (~halted))
+            d2, l2, changed = dirty_chunks(new_table, table)
+            return new_mark, new_table, d2, l2, changed
+
+        table0 = pack_table(mark0 & (~halted))
+        d0, l0, changed0 = dirty_chunks(table0, jnp.zeros_like(table0))
+        mark, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (mark0, table0, d0, l0, changed0)
+        )
+        return mark.reshape(1, -1)
+
+    spec_nodes = P(axis)
+    spec_dev = P(axis, None)
+    spec_dev3 = P(axis, None, None)
+
+    in_specs = (
+        spec_nodes,
+        spec_nodes,
+        spec_dev,
+        spec_dev,
+        spec_dev3,
+        spec_dev3,
+        spec_dev,
+        spec_dev,
+    )
+    try:
+        # pallas_call does not propagate the varying-mesh-axes annotation;
+        # disable the check (named check_vma on current jax, check_rep
+        # on older releases).
+        fn = shard_map(
+            local_trace,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=spec_dev,
+            check_vma=False,
+        )
+    except TypeError:
+        fn = shard_map(
+            local_trace,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=spec_dev,
+            check_rep=False,
+        )
+
+    @jax.jit
+    def traced(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
+        return fn(
+            flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst
+        ).reshape(-1)
+
+    return traced
+
+
+def make_sharded_mask(mesh, axis: str = "gc"):
+    """Per-shard deletion masking for the stacked packed layouts: scatter
+    the inert sentinel into (ri, col) slots of each shard's row_pos/emeta
+    (the device half of IncrementalPallasLayout-style in-place deletes).
+    Buffers are donated — per wake this is an O(churn) in-place scatter.
+
+    fn(row_pos, emeta, ri, col) with row_pos/emeta [D, nb*8, LANE] and
+    ri/col [D, k] (ri padded with nb*8 = dropped)."""
+    jax, jnp = _jax()
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import pallas_trace as pt
+
+    def local_mask(row_pos, emeta, ri, col):
+        rp = row_pos.reshape(row_pos.shape[1], row_pos.shape[2])
+        em = emeta.reshape(emeta.shape[1], emeta.shape[2])
+        r = ri.reshape(-1)
+        c = col.reshape(-1)
+        rp = rp.at[r, c].set(pt._PAD_ROW, mode="drop")
+        em = em.at[r, c].set(0, mode="drop")
+        return rp[None], em[None]
+
+    fn = shard_map(
+        local_mask,
+        mesh=mesh,
+        in_specs=(P(axis, None, None), P(axis, None, None), P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None, None), P(axis, None, None)),
+    )
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def mask(row_pos, emeta, ri, col):
+        return fn(row_pos, emeta, ri, col)
+
+    return mask
+
+
 def make_sharded_fold(mesh, axis: str = "gc", donate: bool = False):
     """Build the jitted multi-device fold step: scatter a batch of entry
     deltas (recv-count deltas + flag overwrites, bucketed by node shard on

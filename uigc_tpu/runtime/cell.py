@@ -83,6 +83,7 @@ class ActorCell:
         "_needs_block_hook",
         "on_finished_processing",
         "_anon_counter",
+        "__weakref__",  # the wire codec's uid registry holds cells weakly
     )
 
     def __init__(

@@ -13,14 +13,32 @@ recovery paths — the in-repo multi-node harness the reference lacks
 Link guarantees mirror a single-lane Artery stream: per-link FIFO
 (GUIDE.md requires one lane so ingress entries see an ordered stream).
 Control-plane traffic between collectors (delta graphs, ingress-entry
-broadcasts) uses ``control_send`` — reliable and not subject to drops,
+broadcasts) is direct cell-to-cell — reliable and not subject to drops,
 like the reference's system-actor messaging.
+
+Two optional hardening modes push the simulation to the reference's real
+deployment discipline:
+
+- ``serialize=True``: every application message crosses the link as
+  *bytes* (runtime/wire.py), so no object identity survives — refobs and
+  cell references are re-materialized from (address, uid) tokens at the
+  destination, the way Artery's serialization forces
+  (reference.conf:2-10).
+- ``async_links=True``: delivery is decoupled from the sender — messages
+  and window-boundary markers ride a FIFO queue drained by a fabric
+  worker thread, and the ingress finalizes the entry whose id matches the
+  egress marker traveling in-stream (reference: Gateways.scala:83-94,
+  168-171), tolerating in-flight next-window traffic instead of relying
+  on lockstep under a synchronous link lock.
 """
 
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
+
+from . import wire
 
 if TYPE_CHECKING:  # pragma: no cover
     from .cell import ActorCell
@@ -49,9 +67,15 @@ class MemberRemoved:
 
 class Link:
     """One directed link between two systems, with its engine-supplied
-    egress (at the sender) and ingress (at the receiver) interceptors."""
+    egress (at the sender) and ingress (at the receiver) interceptors.
 
-    __slots__ = ("src", "dst", "egress", "ingress", "lock", "drop_filter")
+    ``send_lock`` serializes the egress stage (window stamping must be
+    FIFO with enqueue order); ``recv_lock`` serializes the ingress stage
+    (tallying and window finalization).  The synchronous delivery path
+    holds both in order; the async path splits them between the sender
+    and the drain worker."""
+
+    __slots__ = ("src", "dst", "egress", "ingress", "send_lock", "recv_lock", "drop_filter")
 
     def __init__(self, src: "ActorSystem", dst: "ActorSystem"):
         self.src = src
@@ -60,17 +84,25 @@ class Link:
         # reference: Engine.scala:225-276).
         self.egress = src.engine.spawn_egress(self)
         self.ingress = dst.engine.spawn_ingress(self)
-        self.lock = threading.Lock()
+        self.send_lock = threading.Lock()
+        self.recv_lock = threading.Lock()
         self.drop_filter: Optional[Callable[[Any], bool]] = None
 
 
 class Fabric:
-    def __init__(self) -> None:
+    def __init__(self, serialize: bool = False, async_links: bool = False) -> None:
         self._lock = threading.Lock()
         self.systems: Dict[str, "ActorSystem"] = {}
         self.crashed: set = set()
         self._links: Dict[Tuple[str, str], Link] = {}
         self._subscribers: List["ActorCell"] = []
+        self.serialize = serialize
+        self.async_links = async_links
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._worker: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
 
     # ------------------------------------------------------------- #
     # Membership (reference: LocalGC.scala:69-86,198-243)
@@ -145,38 +177,119 @@ class Fabric:
         self, src: "ActorSystem", target: "ActorCell", msg: Any
     ) -> None:
         """Send an application message across a link: egress interception,
-        optional drop, ingress interception, then local delivery
+        (optional) serialization, FIFO transit, (optional) drop, ingress
+        interception, then local delivery
         (reference: Gateways.scala:72-115,153-191)."""
         dst = target.system
         if src.address in self.crashed:
             return
         link = self.link(src, dst)
-        with link.lock:
+        with link.send_lock:
             if link.egress is not None:
                 link.egress.on_message(target, msg)
-            dropped = link.drop_filter is not None and link.drop_filter(msg)
-            if dropped or dst.address in self.crashed:
+            payload = wire.encode_message(msg) if self.serialize else msg
+            if self.async_links:
+                self._enqueue(("msg", link, target, payload))
                 return
+            # Synchronous mode: tally under recv_lock *before* releasing
+            # send_lock, so a window's marker (finalize_egress, which
+            # acquires send_lock first) cannot finalize between this
+            # message's stamp and its tally — a stamped-but-untallied
+            # message would otherwise land in a window that already
+            # closed and strand its admitted counts.
+            self._deliver_now(link, target, payload)
+
+    def _deliver_now(self, link: Link, target: "ActorCell", payload: Any) -> None:
+        msg = (
+            wire.decode_message(self, payload) if self.serialize else payload
+        )
+        if link.drop_filter is not None and link.drop_filter(msg):
+            return
+        if link.dst.address in self.crashed:
+            return
+        with link.recv_lock:
             if link.ingress is not None:
                 link.ingress.on_message(target, msg)
-        target.tell(msg)
+            # tell under recv_lock keeps mailbox order consistent with
+            # the ingress tally order (per-link FIFO all the way down).
+            target.tell(msg)
 
     def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
-        """Ask the egress of link (src -> dst) to finalize its entry and
-        push the boundary marker to the ingress, which finalizes the
-        matching admitted-entry and hands it to the destination collector
+        """Ask the egress of link (src -> dst) to close its window and
+        push the boundary marker down the link; the ingress finalizes the
+        admitted-entry whose id *matches the marker*, so next-window
+        traffic already in flight lands in its own entry
         (reference: Gateways.scala:87-94,168-171)."""
         with self._lock:
             dst = self.systems.get(dst_address)
         if dst is None or dst_address in self.crashed or src.address in self.crashed:
             return
         link = self.link(src, dst)
-        with link.lock:
-            if link.egress is not None and link.ingress is not None:
-                link.egress.finalize_entry()
-                # Marker traverses the (FIFO, in-process) link immediately.
-                link.ingress.finalize_and_send()
+        with link.send_lock:
+            if link.egress is None or link.ingress is None:
+                return
+            marker = link.egress.finalize_entry()
+            if self.async_links:
+                self._enqueue(("marker", link, marker.id))
+                return
+            with link.recv_lock:
+                link.ingress.finalize_window(marker.id)
 
-    def ingress_links_to(self, dst: "ActorSystem") -> List[Link]:
+    def finalize_dead_link(self, src_address: str, dst: "ActorSystem") -> None:
+        """A node died: after any in-flight traffic drains, flush every
+        open ingress window of the (dead -> dst) link and emit the final
+        entry that joins the crash quorum (reference: Gateways.scala:129,
+        LocalGC.scala:228-243).  Queued-but-undelivered messages simply
+        never reach the ingress tally — they stay *unadmitted*, which is
+        exactly what the undo log reverts (UndoLog.java:39-93)."""
         with self._lock:
-            return [l for (s, d), l in self._links.items() if d == dst.address]
+            link = self._links.get((src_address, dst.address))
+        if link is None or link.ingress is None:
+            return
+        if self.async_links:
+            self._enqueue(("final", link))
+            return
+        with link.recv_lock:
+            link.ingress.finalize_all(is_final=True)
+
+    # ------------------------------------------------------------- #
+    # Async transit (single drain worker: global FIFO, per-link FIFO)
+    # ------------------------------------------------------------- #
+
+    def _enqueue(self, item: tuple) -> None:
+        with self._cv:
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain_loop, name="fabric-drain", daemon=True
+                )
+                self._worker.start()
+            self._queue.append(item)
+            self._idle.clear()
+            self._cv.notify()
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._idle.set()
+                    self._cv.wait()
+                item = self._queue.popleft()
+            kind, link = item[0], item[1]
+            try:
+                if kind == "msg":
+                    _, _, target, payload = item
+                    self._deliver_now(link, target, payload)
+                elif kind == "marker":
+                    with link.recv_lock:
+                        link.ingress.finalize_window(item[2])
+                else:  # "final"
+                    with link.recv_lock:
+                        link.ingress.finalize_all(is_final=True)
+            except Exception:  # pragma: no cover - keep the lane alive
+                import traceback
+
+                traceback.print_exc()
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Wait until the transit queue is drained (tests)."""
+        return self._idle.wait(timeout_s)

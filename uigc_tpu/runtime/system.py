@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import weakref
 from typing import Any, Dict, Mapping, Optional
 
 from ..config import Config
@@ -74,6 +75,10 @@ class ActorSystem:
         self.timers = TimerService(name=f"{name}-timers")
         self._pinned: list = []
         self._cells: Dict[int, ActorCell] = {}
+        # Weak uid -> cell map covering stopped actors too: the wire
+        # codec must resolve refs to actors that have already terminated
+        # (their tell() dead-letters, like Akka's resolve of a dead path).
+        self._cells_ever = weakref.WeakValueDictionary()
         self._cells_lock = threading.Lock()
         self.dead_letters = 0
         self._terminated = threading.Event()
@@ -181,10 +186,20 @@ class ActorSystem:
     def register_cell(self, cell: ActorCell) -> None:
         with self._cells_lock:
             self._cells[cell.uid] = cell
+            self._cells_ever[cell.uid] = cell
 
     def unregister_cell(self, cell: ActorCell) -> None:
         with self._cells_lock:
             self._cells.pop(cell.uid, None)
+
+    def resolve_cell(self, uid: int):
+        """Resolve a wire uid to its cell (live or stopped-but-reachable);
+        None when the cell is truly gone."""
+        with self._cells_lock:
+            cell = self._cells.get(uid)
+            if cell is None:
+                cell = self._cells_ever.get(uid)
+            return cell
 
     def record_dead_letter(self, cell: ActorCell, msg: Any) -> None:
         self.dead_letters += 1

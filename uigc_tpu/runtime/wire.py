@@ -1,0 +1,103 @@
+"""Cross-node message serialization: the byte boundary between systems.
+
+Stands in for Artery's serialization layer (reference: reference.conf:2-10
+routes every cross-node envelope through Akka serialization;
+streams/Egress.scala:9-21 intercepts the serialized stream).  A fabric in
+``serialize`` mode pushes every application message through this codec, so
+nothing object-identical crosses a link: refobs and actor references are
+reduced to (system address, uid) tokens and re-materialized against the
+destination's registry — exactly the discipline a real two-process
+deployment forces, and the one an in-process fabric silently skips.
+
+Messages are pickled; GC-managed reference types are intercepted with
+``persistent_id`` so user payloads need no special support beyond being
+picklable.  A refob arrives as a *fresh* instance: its mutable sender-side
+bookkeeping (send counts, recorded flag) stays at the sender, which is the
+protocol's intent — counts travel in entries, never inside refs
+(reference: crgc/Refob.scala:12-17 marks the shadow cache transient).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .fabric import Fabric
+
+
+def encode_cell(cell) -> bytes:
+    """Stable wire token for an actor cell: address + uid + path."""
+    return f"{cell.system.address}|{cell.uid}|{cell.path}".encode()
+
+
+def make_decode_cell(fabric: "Fabric"):
+    def decode_cell(data: bytes):
+        address, uid, _path = data.decode().split("|", 2)
+        return _resolve(fabric, address, int(uid))
+
+    return decode_cell
+
+
+def _resolve(fabric: "Fabric", address: str, uid: int):
+    system = fabric.systems.get(address)
+    if system is None:
+        raise LookupError(f"unknown system {address!r} on this fabric")
+    cell = system.resolve_cell(uid)
+    if cell is None:
+        raise LookupError(f"no cell uid={uid} in {address!r}")
+    return cell
+
+
+class _Pickler(pickle.Pickler):
+    def persistent_id(self, obj: Any):
+        from ..engines.crgc.refob import CrgcRefob
+        from ..interfaces import Refob
+        from .cell import ActorCell
+        from .system import RawRef
+
+        if isinstance(obj, CrgcRefob):
+            t = obj._target
+            return ("refob", t.system.address, t.uid)
+        if isinstance(obj, Refob):
+            # engine-agnostic fallback: re-materialize through the
+            # destination engine's root conversion
+            t = obj.target
+            return ("ref", t.system.address, t.uid)
+        if isinstance(obj, ActorCell):
+            return ("cell", obj.system.address, obj.uid)
+        if isinstance(obj, RawRef):
+            return ("rawref", obj.cell.system.address, obj.cell.uid)
+        return None
+
+
+class _Unpickler(pickle.Unpickler):
+    def __init__(self, buf, fabric: "Fabric"):
+        super().__init__(buf)
+        self._fabric = fabric
+
+    def persistent_load(self, pid):
+        kind, address, uid = pid
+        cell = _resolve(self._fabric, address, uid)
+        if kind == "refob":
+            from ..engines.crgc.refob import CrgcRefob
+
+            return CrgcRefob(cell)
+        if kind == "ref":
+            return cell.system.engine.to_root_refob(cell)
+        if kind == "rawref":
+            from .system import RawRef
+
+            return RawRef(cell)
+        return cell
+
+
+def encode_message(msg: Any) -> bytes:
+    buf = io.BytesIO()
+    _Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(msg)
+    return buf.getvalue()
+
+
+def decode_message(fabric: "Fabric", data: bytes) -> Any:
+    return _Unpickler(io.BytesIO(data), fabric).load()
