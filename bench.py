@@ -115,6 +115,16 @@ def main() -> None:
         help="trace implementation (default: pallas on TPU, xla elsewhere)",
     )
     parser.add_argument(
+        "--layout",
+        choices=["static", "incremental"],
+        default="static",
+        help=(
+            "pallas pair layout: one static pack, or the live collector's "
+            "incremental base+delta layout with device-resident operands "
+            "(ops/pallas_incremental.trace_device)"
+        ),
+    )
+    parser.add_argument(
         "--config",
         choices=["powerlaw", "churn", "mac", "rings", "cluster"],
         default="powerlaw",
@@ -200,7 +210,22 @@ def main() -> None:
     graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=args.garbage_fraction)
 
     def build(impl):
-        if impl == "pallas":
+        if impl == "pallas" and args.layout == "incremental":
+            from uigc_tpu.ops import pallas_incremental
+
+            layout = pallas_incremental.IncrementalPallasLayout(n)
+            layout.rebuild(
+                graph["edge_src"],
+                graph["edge_dst"],
+                graph["edge_weight"],
+                graph["supervisor"],
+            )
+
+            def fn(flags_dev, recv_dev):
+                return layout.trace_device(flags_dev, recv_dev)
+
+            host_args = (graph["flags"], graph["recv_count"])
+        elif impl == "pallas":
             from uigc_tpu.ops import pallas_trace
 
             prep = pallas_trace.prepare_chunks(
@@ -267,7 +292,10 @@ def main() -> None:
     # - Slow traces: per-call timing with readback; the sync floor is
     #   noise at this scale.  Never enqueue a multi-minute mega-program.
     budget_s = 20.0
-    if one_shot < 0.25:
+    # The incremental layout's wake fn does host-side layout maintenance,
+    # so it cannot be chained inside one jitted program.
+    chainable = args.layout != "incremental"
+    if one_shot < 0.25 and chainable:
         import jax.numpy as jnp
 
         @jax.jit
@@ -360,6 +388,7 @@ def main() -> None:
         "platform_degraded": probe["degraded"],
         "probe": probe["probe"],
         "impl": impl,
+        "layout": args.layout,
     }
     print(json.dumps(result))
 
