@@ -206,6 +206,8 @@ def main() -> None:
     from uigc_tpu.ops import trace as trace_ops
 
     impl = args.impl or ("pallas" if is_tpu else "xla")
+    if args.layout == "incremental" and impl != "pallas":
+        parser.error("--layout incremental requires the pallas impl")
 
     graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=args.garbage_fraction)
 
@@ -263,7 +265,7 @@ def main() -> None:
     try:
         mark = fn(*dev_args)
     except Exception as exc:
-        if args.impl is not None or impl != "pallas":
+        if args.impl is not None or impl != "pallas" or args.layout == "incremental":
             raise
         probe["probe"] += f"; pallas warmup failed: {str(exc)[:200]}"
         impl = "xla"

@@ -1,0 +1,144 @@
+"""Decompose the Pallas trace's per-sweep cost at graph scale.
+
+Times three things the full fixpoint mixes together (bench.py reports
+only their sum across ~12 sweeps):
+
+- a **full-dirty** propagation sweep (every chunk dirty: worst-case walk
+  + every block's one-hot contraction);
+- a **no-dirty** sweep (empty dirty list: pure grid/stream overhead —
+  every block still streams its row_pos/emeta and runs the skip branch);
+- the **pack** of the mark vector into the word table (per-sweep XLA
+  cost outside the kernel).
+
+Prints one JSON line.  Usage: python tools/sweep_profile.py [--n 10000000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def timed(fn, *args, reps=5):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--small", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from uigc_tpu.models import powerlaw_actor_graph
+    from uigc_tpu.ops import pallas_trace as pt
+    from uigc_tpu.utils.platform import apply_platform_override, is_tpu_platform
+
+    apply_platform_override()
+    on_tpu = is_tpu_platform(jax.devices()[0].platform)
+    n = args.n or (10_000_000 if on_tpu and not args.small else 1 << 16)
+
+    graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=0.5)
+    t0 = time.perf_counter()
+    prep = pt.prepare_chunks(
+        graph["edge_src"].astype(np.int32),
+        graph["edge_dst"].astype(np.int32),
+        graph["edge_weight"],
+        graph["supervisor"],
+        n,
+    )
+    pack_host_s = time.perf_counter() - t0
+    r_rows, s_rows, n_super = prep["r_rows"], prep["s_rows"], prep["n_super"]
+    n_blocks = prep["n_blocks"]
+    n_chunks = r_rows // pt.ROWS
+
+    propagate = pt.build_propagate(
+        n_blocks, n_super, r_rows, s_rows, pt.default_interpret()
+    )
+    dev = {
+        k: jax.device_put(prep[k])
+        for k in ("bmeta1", "bmeta2", "row_pos", "emeta")
+    }
+
+    rng = np.random.default_rng(0)
+    table = jax.device_put(
+        rng.integers(0, 1 << 31, (r_rows, pt.LANE), dtype=np.int32)
+    )
+    d_full = jax.device_put(np.arange(n_chunks + 1, dtype=np.int32))
+    l_full = jax.device_put(np.arange(n_chunks, dtype=np.int32))
+    d_none = jax.device_put(np.zeros(n_chunks + 1, dtype=np.int32))
+
+    full_ms = timed(
+        propagate, d_full, l_full, dev["bmeta1"], dev["bmeta2"], table,
+        dev["row_pos"], dev["emeta"],
+    )
+    none_ms = timed(
+        propagate, d_none, l_full, dev["bmeta1"], dev["bmeta2"], table,
+        dev["row_pos"], dev["emeta"],
+    )
+
+    # half the chunks dirty (even ids): the mid-fixpoint regime
+    diff = np.zeros(n_chunks, bool)
+    diff[::2] = True
+    dd = np.concatenate([[0], np.cumsum(diff)]).astype(np.int32)
+    ll = np.zeros(n_chunks, np.int32)
+    ll[dd[:-1][diff]] = np.nonzero(diff)[0].astype(np.int32)
+    half_ms = timed(
+        propagate, jax.device_put(dd), jax.device_put(ll), dev["bmeta1"],
+        dev["bmeta2"], table, dev["row_pos"], dev["emeta"],
+    )
+
+    shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
+
+    @jax.jit
+    def pack(active):
+        a = jnp.zeros(r_rows * pt.LANE * pt.WORD_BITS, jnp.int32)
+        a = a.at[:n].set(active.astype(jnp.int32))
+        w = (a.reshape(-1, pt.WORD_BITS) << shifts[None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
+        return w.reshape(r_rows, pt.LANE)
+
+    active = jax.device_put(np.ones(n, bool))
+    pack_ms = timed(pack, active)
+
+    print(
+        json.dumps(
+            {
+                "bench": "sweep_profile",
+                "n_actors": n,
+                "n_blocks": n_blocks,
+                "n_chunks": n_chunks,
+                "n_pairs": prep["n_pairs"],
+                "host_pack_s": round(pack_host_s, 2),
+                "sweep_full_dirty_ms": round(full_ms, 2),
+                "sweep_half_dirty_ms": round(half_ms, 2),
+                "sweep_no_dirty_ms": round(none_ms, 2),
+                "pack_table_ms": round(pack_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,7 +20,7 @@ trace.  Multi-node (num-nodes > 1, attached to a Fabric):
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Any, Dict, Set
+from typing import TYPE_CHECKING, Any, Dict, Optional, Set
 
 from ...runtime.behaviors import RawBehavior
 from ...runtime.fabric import MemberRemoved, MemberUp
@@ -59,11 +59,28 @@ FINALIZE_EGRESSES = _FinalizeEgresses()
 class DeltaMsg:
     """(reference: LocalGC.scala:26-28)"""
 
-    __slots__ = ("seqnum", "graph")
+    __slots__ = ("seqnum", "graph", "_wire_buf")
 
     def __init__(self, seqnum: int, graph: DeltaGraph):
         self.seqnum = seqnum
         self.graph = graph
+        self._wire_buf: Optional[bytes] = None
+
+    def reencode(self, fabric, dst_system) -> "DeltaMsg":
+        """Cross a serialized fabric as the DeltaGraph wire format
+        (reference: DeltaGraph.java:189-232).  The encode side is
+        destination-independent, so a broadcast serializes once and
+        decodes per peer."""
+        from ...runtime import wire
+
+        if self._wire_buf is None:
+            self._wire_buf = self.graph.serialize(wire.encode_cell)
+        graph = DeltaGraph.deserialize(
+            self._wire_buf,
+            dst_system.engine.crgc_context,
+            wire.make_decode_cell(fabric),
+        )
+        return DeltaMsg(self.seqnum, graph)
 
 
 class LocalIngressEntry:
@@ -78,10 +95,23 @@ class LocalIngressEntry:
 class RemoteIngressEntry:
     """(reference: LocalGC.scala:35-37)"""
 
-    __slots__ = ("entry",)
+    __slots__ = ("entry", "_wire_buf")
 
     def __init__(self, entry: IngressEntry):
         self.entry = entry
+        self._wire_buf: Optional[bytes] = None
+
+    def reencode(self, fabric, dst_system) -> "RemoteIngressEntry":
+        """Cross a serialized fabric as the IngressEntry wire format
+        (reference: IngressEntry.java:103-144), encoded once per
+        broadcast."""
+        from ...runtime import wire
+
+        if self._wire_buf is None:
+            self._wire_buf = self.entry.serialize(wire.encode_cell)
+        return RemoteIngressEntry(
+            IngressEntry.deserialize(self._wire_buf, wire.make_decode_cell(fabric))
+        )
 
 
 class Bookkeeper(RawBehavior):
@@ -222,9 +252,12 @@ class Bookkeeper(RawBehavior):
 
     def handle_local_ingress_entry(self, entry: IngressEntry) -> None:
         # Tell every remote GC except the one adjacent to this entry.
+        fabric = self.engine.system.fabric
         for addr, gc in self.remote_gcs.items():
             if addr != entry.egress_address:
-                gc.tell(RemoteIngressEntry(entry))
+                fabric.control_send(
+                    self.engine.system, gc, RemoteIngressEntry(entry)
+                )
         with events.recorder.timed(events.MERGING_INGRESS_ENTRIES):
             self.merge_ingress_entry(entry)
 
@@ -300,8 +333,10 @@ class Bookkeeper(RawBehavior):
 
     def finalize_delta_graph(self) -> None:
         """(reference: LocalGC.scala:191-196)"""
+        fabric = self.engine.system.fabric
+        msg = DeltaMsg(self.delta_graph_id, self.delta_graph)
         for gc in self.remote_gcs.values():
-            gc.tell(DeltaMsg(self.delta_graph_id, self.delta_graph))
+            fabric.control_send(self.engine.system, gc, msg)
         self.delta_graph_id += 1
         self.delta_graph = DeltaGraph(self.engine.system.address, self.engine.crgc_context)
 

@@ -252,6 +252,26 @@ class Fabric:
         with link.recv_lock:
             link.ingress.finalize_all(is_final=True)
 
+    def control_send(self, src: "ActorSystem", target_cell: "ActorCell", msg: Any) -> None:
+        """Collector control plane: reliable, ordered cell-to-cell
+        delivery (the reference's Bookkeeper ActorSelection gossip,
+        LocalGC.scala:201), not subject to drops or the app-message
+        queue.  In serialize mode the payload still crosses as bytes —
+        delta graphs and ingress entries through their own wire formats
+        (DeltaGraph.java:189-232, IngressEntry.java:103-144), everything
+        else through the generic codec."""
+        if src.address in self.crashed:
+            return
+        if target_cell.system.address in self.crashed:
+            return
+        if self.serialize:
+            reencode = getattr(msg, "reencode", None)
+            if reencode is not None:
+                msg = reencode(self, target_cell.system)
+            else:
+                msg = wire.decode_message(self, wire.encode_message(msg))
+        target_cell.tell(msg)
+
     # ------------------------------------------------------------- #
     # Async transit (single drain worker: global FIFO, per-link FIFO)
     # ------------------------------------------------------------- #
