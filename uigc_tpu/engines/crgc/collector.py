@@ -251,13 +251,13 @@ class Bookkeeper(RawBehavior):
                 self.undo_logs[graph.address].merge_delta_graph(graph)
 
     def handle_local_ingress_entry(self, entry: IngressEntry) -> None:
-        # Tell every remote GC except the one adjacent to this entry.
+        # Tell every remote GC except the one adjacent to this entry
+        # (one message object, so serialize mode encodes once).
         fabric = self.engine.system.fabric
+        msg = RemoteIngressEntry(entry)
         for addr, gc in self.remote_gcs.items():
             if addr != entry.egress_address:
-                fabric.control_send(
-                    self.engine.system, gc, RemoteIngressEntry(entry)
-                )
+                fabric.control_send(self.engine.system, gc, msg)
         with events.recorder.timed(events.MERGING_INGRESS_ENTRIES):
             self.merge_ingress_entry(entry)
 
