@@ -15,6 +15,7 @@ from __future__ import annotations
 import struct
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 
+from ...utils import events
 from . import refob as refob_info
 from .state import CrgcContext, Entry
 
@@ -178,12 +179,21 @@ class DeltaGraph:
         parts = [struct.pack(">h", len(addr)), addr, struct.pack(">h", self.size)]
         for shadow in self.shadows:
             parts.append(shadow.serialize())
+        shadow_size = sum(len(p) for p in parts)
         assert len(self.compression_table) == self.size
         for cell, idx in self.compression_table.items():
             ref = encode_cell(cell)
             parts.append(struct.pack(">hh", idx, len(ref)))
             parts.append(ref)
-        return b"".join(parts)
+        data = b"".join(parts)
+        if events.recorder.enabled:
+            # (reference: DeltaGraph.java:190-210 records both sizes)
+            events.recorder.commit(
+                events.DELTA_GRAPH_SERIALIZATION,
+                shadow_size=shadow_size,
+                compression_table_size=len(data) - shadow_size,
+            )
+        return data
 
     @staticmethod
     def deserialize(
