@@ -115,6 +115,8 @@ def test_sharded_pallas_matches_host(seed):
         meta["r_rows"],
         s_rows,
         m,
+        sub=meta["sub"],
+        group=meta["group"],
     )
     mark = np.asarray(
         traced(

@@ -407,6 +407,8 @@ class MeshShadowGraph(ArrayShadowGraph):
                     meta["r_rows"],
                     self.s_rows,
                     self._bucket_m,
+                    sub=meta["sub"],
+                    group=meta["group"],
                 )
                 self._trace_cache[key] = traced
             mark = traced(

@@ -73,6 +73,10 @@ class IncrementalPallasLayout:
     ):
         self.n = n
         self.s_rows = s_rows
+        # Pin the kernel walk geometry once: base and delta tiers must
+        # agree (they share one trace), and a mid-life platform change
+        # must not silently mix geometries.
+        self.sub, self.group = pt.default_geometry(interpret)
         self.repack_fraction = repack_fraction
         self.min_repack = min_repack
         self.freeze_threshold = freeze_threshold
@@ -143,6 +147,8 @@ class IncrementalPallasLayout:
             s_rows=self.s_rows,
             pad_blocks_pow2=True,
             want_slots=True,
+            sub=self.sub,
+            group=self.group,
         )
         slot_ri = self.base.pop("slot_ri")
         slot_col = self.base.pop("slot_col")
@@ -171,6 +177,8 @@ class IncrementalPallasLayout:
             pad_blocks_pow2=True,
             want_slots=True,
             compact_supers=True,
+            sub=self.sub,
+            group=self.group,
         )
         slot_ri = prep.pop("slot_ri")
         slot_col = prep.pop("slot_col")
@@ -201,6 +209,8 @@ class IncrementalPallasLayout:
             pad_blocks_pow2=True,
             want_slots=True,
             compact_supers=True,
+            sub=self.sub,
+            group=self.group,
         )
         slot_ri = prep.pop("slot_ri")
         slot_col = prep.pop("slot_col")

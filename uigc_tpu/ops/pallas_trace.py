@@ -64,7 +64,26 @@ import numpy as np
 from . import trace as trace_ops
 
 LANE = 128  # lanes per vreg row
-ROWS = 8  # sublane rows per edge-slot block (8 * 128 edge slots per step)
+ROWS = 8  # sublane rows per edge-slot sub-block (slot row = src row mod 8)
+#: default slot sub-blocks merged into one grid step on a real chip.
+#: Each grid step streams a (ROWS * sub, LANE) slot block and runs ONE
+#: (s_rows, ROWS*sub*LANE) @ (ROWS*sub*LANE, LANE) one-hot contraction —
+#: sub-fold fewer grid steps (and their fixed stream/dispatch cost) for
+#: the same total edges.
+SUB_TPU = 4
+#: default 8-row table chunks walked per gather-loop iteration on a real
+#: chip.  The chunk walk was the measured bottleneck at graph scale
+#: (~250ns/iteration of serial loop overhead for ~30ns of VPU work);
+#: walking `group` chunks per iteration cuts iterations ~group-fold and
+#: amortizes the overhead over `group` statically-unrolled sub-gathers.
+GROUP_TPU = 8
+#: interpret-mode defaults.  The wide geometry statically unrolls
+#: sub*group gather stages per chunk iteration — on the CPU test tier
+#: that inflates XLA compile time by minutes per trace geometry (enough
+#: to stall a collector thread mid-protocol), while buying nothing
+#: (interpret mode has no per-step hardware overhead to amortize).
+SUB_CPU = 1
+GROUP_CPU = 1
 WORD_BITS = 32
 #: default output sublane rows per block (s_rows * 128 dst nodes per
 #: supertile).  32 is the packing limit (dst_sub is 5 bits) and measured
@@ -77,6 +96,43 @@ _PAD_ROW = np.int32(1 << 28)
 _SPAN_BITS = 12  # chunk index / span fit in 12 bits up to ~134M actors
 #: quantum for large-layout block padding (see _pad_blocks_target)
 _BLOCK_QUANTUM = 8192
+#: bump when prepare_pairs' output format changes (layout caches key on
+#: it; tools/sweep_profile.py persists packed layouts across runs)
+PACK_FORMAT_VERSION = 2
+
+
+def pack_hits_words(hits2d, jnp):
+    """Word-pack a (t, LANE) boolean hits plane into flat int32 words.
+
+    The one layout invariant every fixpoint pack shares: lane g*32+b of
+    row t is bit b of flat word t*4+g (node id = 32*word + bit), so the
+    flat words lay out row-major into the (r_rows, LANE) table at
+    position (w >> 7, w & 127).  Callers pad/reshape to their table
+    geometry (global table, shard-local words, or a benchmark probe)."""
+    t = hits2d.shape[0]
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)
+    h3 = hits2d.astype(jnp.int32).reshape(t, LANE // WORD_BITS, WORD_BITS)
+    w = (h3 << shifts[None, None, :]).sum(axis=2, dtype=jnp.int32)
+    return w.reshape(-1)
+
+
+def pack_hits_table(hits2d, r_rows, jnp):
+    """pack_hits_words padded and reshaped into the (r_rows, LANE) word
+    table — the exact per-sweep pack on the fixpoint path (trace_fn's
+    pack2d) and the expression benchmark probes must time."""
+    flat = pack_hits_words(hits2d, jnp)
+    flat = jnp.concatenate(
+        [flat, jnp.zeros((r_rows * LANE - flat.shape[0],), jnp.int32)]
+    )
+    return flat.reshape(r_rows, LANE)
+
+
+def default_geometry(interpret: bool | None = None) -> tuple:
+    """(sub, group) for new layouts: wide on a real chip, minimal in
+    interpret mode (see SUB_CPU note)."""
+    if interpret is None:
+        interpret = default_interpret()
+    return (SUB_CPU, GROUP_CPU) if interpret else (SUB_TPU, GROUP_TPU)
 
 
 def _pad_blocks_target(n_blocks: int) -> int:
@@ -98,6 +154,8 @@ def prepare_chunks(
     n: int,
     s_rows: int = S_ROWS,
     pad_blocks_pow2: bool = False,
+    sub: int = None,
+    group: int = None,
 ) -> Dict[str, np.ndarray]:
     """Host-side packer: place propagation pairs into kernel blocks.
 
@@ -120,7 +178,8 @@ def prepare_chunks(
         psrc = np.concatenate([psrc, sup_src])
         pdst = np.concatenate([pdst, supervisor[sup_src].astype(np.int64)])
     return prepare_pairs(
-        psrc, pdst, n, s_rows=s_rows, pad_blocks_pow2=pad_blocks_pow2
+        psrc, pdst, n, s_rows=s_rows, pad_blocks_pow2=pad_blocks_pow2,
+        sub=sub, group=group,
     )
 
 
@@ -133,6 +192,8 @@ def prepare_pairs(
     want_slots: bool = False,
     compact_supers: bool = False,
     n_src: int = None,
+    sub: int = None,
+    group: int = None,
 ) -> Dict[str, np.ndarray]:
     """Pack explicit propagation pairs (already filtered to live ones)
     into kernel blocks.
@@ -155,17 +216,26 @@ def prepare_pairs(
     global ids gathered from the all-gathered table, destinations are
     shard-local (parallel/sharded_trace)."""
     assert 1 <= s_rows <= 32, "dst_sub is packed in 5 bits"
+    if sub is None or group is None:
+        d_sub, d_group = default_geometry()
+        sub = d_sub if sub is None else sub
+        group = d_group if group is None else group
+    block_rows = ROWS * sub
+    group_rows = ROWS * group
     super_sz = s_rows * LANE
     psrc = np.asarray(psrc, dtype=np.int64)
     pdst = np.asarray(pdst, dtype=np.int64)
 
     n_super = max(1, -(-n // super_sz))
     n_pad = n_super * super_sz
-    # Bit table geometry: R rows of 128 lanes of 32-bit words.
+    # Bit table geometry: R rows of 128 lanes of 32-bit words, padded to
+    # whole walk groups.
     n_words = -(-(n_src if n_src is not None else n_pad) // WORD_BITS)
     r_rows = -(-n_words // LANE)
-    r_rows = ((r_rows + ROWS - 1) // ROWS) * ROWS  # multiple of 8
-    assert r_rows // ROWS < (1 << _SPAN_BITS), "graph too large for span packing"
+    r_rows = ((r_rows + group_rows - 1) // group_rows) * group_rows
+    assert r_rows // group_rows < (1 << _SPAN_BITS), (
+        "graph too large for span packing"
+    )
 
     m = psrc.size
     word = psrc >> 5
@@ -211,10 +281,10 @@ def prepare_pairs(
         rank = np.zeros(0, dtype=np.int64)
 
     # blocks needed per (compact) supertile = max over classes of
-    # ceil(class/128)
+    # ceil(ceil(class/128)/sub)
     blocks_needed = np.zeros(n_tiles, dtype=np.int64)
     if m:
-        np.maximum.at(blocks_needed, d_super, rank // LANE + 1)
+        np.maximum.at(blocks_needed, d_super, (rank // LANE) // sub + 1)
     blocks_needed = np.maximum(blocks_needed, 1)  # dummy for empty supertiles
 
     n_blocks = int(blocks_needed.sum())
@@ -222,15 +292,17 @@ def prepare_pairs(
     block_base[1:] = np.cumsum(blocks_needed)[:-1]
 
     # --- fill kernel arrays -------------------------------------------
-    shape = (n_blocks * ROWS, LANE)
+    shape = (n_blocks * block_rows, LANE)
     row_pos = np.full(shape, _PAD_ROW, dtype=np.int32)
     emeta = np.zeros(shape, dtype=np.int32)
 
     slot_ri = slot_col = None
     if m:
-        g_block = block_base[d_super] + rank // LANE
+        sub_idx = rank // LANE  # sub-block sequence within the class
+        g_block = block_base[d_super] + sub_idx // sub
         col = rank % LANE
-        ri = g_block * ROWS + r8  # slot row = source row mod 8
+        # slot row = (sub-block within grid block, source row mod 8)
+        ri = g_block * block_rows + (sub_idx % sub) * ROWS + r8
         if want_slots:
             # Undo the placement sort: slot of the i-th *input* pair.
             slot_ri = np.empty(m, dtype=np.int64)
@@ -244,8 +316,8 @@ def prepare_pairs(
             | ((d_local & 127).astype(np.int32) << 12)
             | ((d_local >> 7).astype(np.int32) << 19)
         )
-        # per-block table-chunk range
-        chunk = (w_row >> 3).astype(np.int64)
+        # per-block table walk-group range
+        chunk = (w_row // group_rows).astype(np.int64)
         c_lo = np.full(n_blocks, 1 << 30, dtype=np.int64)
         c_hi = np.zeros(n_blocks, dtype=np.int64)
         np.minimum.at(c_lo, g_block, chunk)
@@ -282,10 +354,10 @@ def prepare_pairs(
             c_lo = np.concatenate([c_lo, np.zeros(extra_t, dtype=np.int64)])
             span = np.concatenate([span, np.zeros(extra_t, dtype=np.int64)])
             row_pos = np.concatenate(
-                [row_pos, np.full((extra_t * ROWS, LANE), _PAD_ROW, np.int32)]
+                [row_pos, np.full((extra_t * block_rows, LANE), _PAD_ROW, np.int32)]
             )
             emeta = np.concatenate(
-                [emeta, np.zeros((extra_t * ROWS, LANE), np.int32)]
+                [emeta, np.zeros((extra_t * block_rows, LANE), np.int32)]
             )
             n_blocks += extra_t
             n_tiles = k_pad
@@ -305,10 +377,10 @@ def prepare_pairs(
             c_lo = np.concatenate([c_lo, np.zeros(extra, dtype=np.int64)])
             span = np.concatenate([span, np.zeros(extra, dtype=np.int64)])
             row_pos = np.concatenate(
-                [row_pos, np.full((extra * ROWS, LANE), _PAD_ROW, np.int32)]
+                [row_pos, np.full((extra * block_rows, LANE), _PAD_ROW, np.int32)]
             )
             emeta = np.concatenate(
-                [emeta, np.zeros((extra * ROWS, LANE), np.int32)]
+                [emeta, np.zeros((extra * block_rows, LANE), np.int32)]
             )
             n_blocks = padded
 
@@ -327,6 +399,8 @@ def prepare_pairs(
         "n_pad": n_pad,
         "n": n,
         "s_rows": s_rows,
+        "sub": sub,
+        "group": group,
         "n_pairs": int(m),
     }
     if compact_supers:
@@ -353,6 +427,7 @@ def pad_layout_blocks(prep: Dict[str, np.ndarray], target: int) -> None:
     extra = target - prep["n_blocks"]
     if extra <= 0:
         return
+    block_rows = ROWS * prep["sub"]
     n_tiles = prep.get("out_supers", prep["n_super"])
     bmeta1_pad = np.full(extra, (n_tiles - 1) << 1, dtype=np.int32)
     prep["bmeta1"] = np.concatenate([prep["bmeta1"], bmeta1_pad])
@@ -360,10 +435,10 @@ def pad_layout_blocks(prep: Dict[str, np.ndarray], target: int) -> None:
         [prep["bmeta2"], np.zeros(extra, dtype=np.int32)]
     )
     prep["row_pos"] = np.concatenate(
-        [prep["row_pos"], np.full((extra * ROWS, LANE), _PAD_ROW, np.int32)]
+        [prep["row_pos"], np.full((extra * block_rows, LANE), _PAD_ROW, np.int32)]
     )
     prep["emeta"] = np.concatenate(
-        [prep["emeta"], np.zeros((extra * ROWS, LANE), np.int32)]
+        [prep["emeta"], np.zeros((extra * block_rows, LANE), np.int32)]
     )
     prep["n_blocks"] = target
 
@@ -402,8 +477,14 @@ def layout_spec(prep: Dict[str, np.ndarray]) -> tuple:
     if "xla_src" in prep:
         return ("xla", prep["capacity"])
     if "out_supers" in prep:
-        return ("compact", prep["n_blocks"], prep["out_supers"])
-    return ("dense", prep["n_blocks"])
+        return (
+            "compact",
+            prep["n_blocks"],
+            prep["out_supers"],
+            prep["sub"],
+            prep["group"],
+        )
+    return ("dense", prep["n_blocks"], prep["sub"], prep["group"])
 
 
 def build_propagate(
@@ -412,6 +493,8 @@ def build_propagate(
     r_rows: int,
     s_rows: int,
     interpret: bool,
+    sub: int = None,
+    group: int = None,
 ):
     """One propagation sweep as a pallas_call: gather source bits from the
     packed table, one-hot segment-sum into per-supertile contributions.
@@ -431,6 +514,13 @@ def build_propagate(
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if sub is None or group is None:
+        d_sub, d_group = default_geometry(interpret)
+        sub = d_sub if sub is None else sub
+        group = d_group if group is None else group
+    block_rows = ROWS * sub
+    group_rows = ROWS * group
+
     def kernel(*refs):
         d_ref, l_ref, meta1_ref, meta2_ref = refs[:4]
         table_ref, row_ref, emeta_ref, out_ref = refs[4:]
@@ -443,7 +533,8 @@ def build_propagate(
         j_lo = d_ref[c_lo]
         j_hi = d_ref[c_lo + span]
 
-        row_iota = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANE), 0)
+        row_iota = jax.lax.broadcasted_iota(jnp.int32, (block_rows, LANE), 0)
+        r8_iota = row_iota & 7  # slot row class = src row mod 8
         sub_iota = jax.lax.broadcasted_iota(jnp.int32, (s_rows, LANE), 0)
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
 
@@ -457,23 +548,41 @@ def build_propagate(
             dst_sub = (emeta >> 19) & 31
 
             def chunk_body(j, acc):
+                # One iteration walks a group_rows-row table group:
+                # `group` statically-unrolled sub-gathers, each matching
+                # slots whose source row falls in that 8-row sub-chunk.
                 c = l_ref[j]
-                tab_c = table_ref[pl.ds(c * ROWS, ROWS), :]
-                g = jnp.take_along_axis(tab_c, lane_idx, axis=1)
-                hit = (row_pos - c * ROWS) == row_iota
-                return jnp.where(hit, g, acc)
+                tab_g = table_ref[pl.ds(c * group_rows, group_rows), :]
+                base = c * group_rows
+                for s in range(group):
+                    sub_c = tab_g[s * ROWS : (s + 1) * ROWS, :]
+                    # Stack the 8-row sub-chunk `sub` times so slot row
+                    # (sb * 8 + r8) gathers from table row (base+8s+r8).
+                    tiled = (
+                        jnp.concatenate([sub_c] * sub, axis=0)
+                        if sub > 1
+                        else sub_c
+                    )
+                    g = jnp.take_along_axis(tiled, lane_idx, axis=1)
+                    hit = (row_pos - (base + s * ROWS)) == r8_iota
+                    acc = jnp.where(hit, g, acc)
+                return acc
 
             words = jax.lax.fori_loop(
-                j_lo, j_hi, chunk_body, jnp.zeros((ROWS, LANE), jnp.int32)
+                j_lo,
+                j_hi,
+                chunk_body,
+                jnp.zeros((block_rows, LANE), jnp.int32),
             )
             bits = jax.lax.shift_right_logical(words, bit_pos) & 1
             vals = bits.astype(jnp.bfloat16)
 
-            # Fused one-hot segment-sum on the MXU: one (s_rows, 1024) @
-            # (1024, 128) contraction per block.
+            # Fused one-hot segment-sum on the MXU: one
+            # (s_rows, block_rows*128) @ (block_rows*128, 128)
+            # contraction per block.
             a_parts = []
             b_parts = []
-            for r in range(ROWS):
+            for r in range(block_rows):
                 # Mask-multiply instead of jnp.where: a where() whose
                 # selected operand is a sublane-broadcast bf16 vector does
                 # not lower through Mosaic on the current TPU toolchain.
@@ -486,8 +595,8 @@ def build_propagate(
                 b_parts.append(
                     (lane_iota == dst_lane[r, :][:, None]).astype(jnp.bfloat16)
                 )
-            a = jnp.concatenate(a_parts, axis=1)  # (s_rows, ROWS*LANE)
-            b = jnp.concatenate(b_parts, axis=0)  # (ROWS*LANE, LANE)
+            a = jnp.concatenate(a_parts, axis=1)  # (s_rows, block_rows*LANE)
+            b = jnp.concatenate(b_parts, axis=0)  # (block_rows*LANE, LANE)
             acc = jnp.dot(a, b, preferred_element_type=jnp.float32)
 
             @pl.when(first)
@@ -511,7 +620,7 @@ def build_propagate(
     def imap_out(i, d, l, m1, m2):
         return (m1[i] >> 1, 0)
 
-    blockmap = pl.BlockSpec((ROWS, LANE), imap_block)
+    blockmap = pl.BlockSpec((block_rows, LANE), imap_block)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(n_blocks,),
@@ -542,13 +651,15 @@ def _build_trace_fn_multi(
     """Trace fn over one or more pair layouts sharing a node space.
 
     ``specs`` holds one static shape signature per layout:
-      ("dense", n_blocks)               — full layout, every supertile
-      ("compact", n_blocks, out_tiles)  — only touched supertiles; the
-        kernel output is scattered into the global contribution by the
-        layout's ``super_ids`` operand
+      ("dense", n_blocks, sub, group)   — full layout, every supertile
+      ("compact", n_blocks, out_tiles, sub, group) — only touched
+        supertiles; the kernel output is scattered into the global
+        contribution by the layout's ``super_ids`` operand
       ("xla", capacity)                 — raw pair arrays propagated by
         an XLA scatter-max; O(capacity) per iteration but zero pack and
         zero recompile cost, the landing tier for the newest churn
+    Packed layouts sharing a trace must share (sub, group): the walk
+    geometry fixes the dirty-list granularity.
 
     Each layout contributes per fixpoint iteration; contributions are
     combined *before* thresholding, so the result is identical to a
@@ -560,25 +671,34 @@ def _build_trace_fn_multi(
 
     F = trace_ops
 
+    geoms = {spec[-2:] for spec in specs if spec[0] != "xla"}
+    assert len(geoms) == 1, "packed layouts must share (sub, group)"
+    ((_, group),) = geoms
+    group_rows = ROWS * group
+
     propagates = []
     for spec in specs:
         if spec[0] == "dense":
             propagates.append(
                 build_propagate(
-                    spec[1], n_super, r_rows, s_rows, interpret
+                    spec[1], n_super, r_rows, s_rows, interpret,
+                    sub=spec[2], group=spec[3],
                 )
             )
         elif spec[0] == "compact":
             propagates.append(
                 build_propagate(
-                    spec[1], spec[2], r_rows, s_rows, interpret
+                    spec[1], spec[2], r_rows, s_rows, interpret,
+                    sub=spec[3], group=spec[4],
                 )
             )
         else:  # xla tier: no kernel
             propagates.append(None)
 
     n_words_pad = r_rows * LANE
-    n_chunks = r_rows // ROWS
+    n_chunks = r_rows // group_rows  # dirty granularity = one walk group
+    n_pad_nodes = n_super * s_rows * LANE  # contrib coverage, >= n
+    t_rows = n_super * s_rows  # contrib rows (128 nodes each)
 
     def trace_fn(flags, recv_count, *layout_args):
         in_use = (flags & F.FLAG_IN_USE) != 0
@@ -595,6 +715,9 @@ def _build_trace_fn_multi(
         chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
 
         def pack(active):
+            """Pack an (n,) bool vector into the (r_rows, LANE) word
+            table.  Used once per trace for the seed/gate vectors; the
+            fixpoint itself stays in word space (pack2d)."""
             a = jnp.zeros(n_words_pad * WORD_BITS, jnp.int32)
             a = a.at[:n].set(active.astype(jnp.int32))
             w = (a.reshape(-1, WORD_BITS) << shifts[None, :]).sum(
@@ -602,12 +725,23 @@ def _build_trace_fn_multi(
             )
             return w.reshape(r_rows, LANE)
 
+        def pack2d(hits2d):
+            """Pack per-sweep hits — already laid out (t_rows, LANE),
+            the contrib layout — into the word table without leaving
+            word space: O(n/32) output instead of the O(n) scatter+shift
+            repack of the bool-space pack."""
+            return pack_hits_table(hits2d, r_rows, jnp)
+
+        def unpack(words):
+            bits = (words.reshape(-1)[:, None] >> shifts[None, :]) & 1
+            return bits.reshape(-1)[:n] > 0
+
         def dirty_chunks(table, table_prev):
             """Prefix D and compacted index list L of the chunks whose
             words changed — the frontier the next sweep must walk."""
             diff = (
                 (table != table_prev)
-                .reshape(n_chunks, ROWS * LANE)
+                .reshape(n_chunks, group_rows * LANE)
                 .any(axis=1)
             )
             counts = diff.astype(jnp.int32)
@@ -627,26 +761,38 @@ def _build_trace_fn_multi(
 
         sub_iota_rows = jnp.arange(s_rows, dtype=jnp.int32)
 
+        # Gate tables: in_use bits (mark gating) and ~halted bits
+        # (propagation gating).  pack() only sets bits < n, so padding
+        # bits stay 0 in both.
+        iu_w = pack(in_use)
+        nh_w = pack(~halted)
+
         def body(carry):
-            mark, table, d, l, _ = carry
-            active = mark & (~halted)
-            contrib = jnp.zeros((n_super * s_rows, LANE), jnp.float32)
-            xla_hits = jnp.zeros((n,), bool)
+            mark_w, table, d, l, _ = carry
+            contrib = jnp.zeros((t_rows, LANE), jnp.float32)
+            xla_hits2d = jnp.zeros((t_rows, LANE), bool)
+            have_xla = False
             pos = 0
             for idx, (spec, propagate) in enumerate(zip(specs, propagates)):
                 if spec[0] == "xla":
                     psrc, pdst = layout_args[pos : pos + 2]
                     pos += 2
-                    active_pad = jnp.concatenate(
-                        [active, jnp.zeros((1,), bool)]
-                    )
-                    src_active = active_pad[psrc]
+                    # Source-active bits gathered straight from the
+                    # packed table; sink pads (src = n) masked out.
+                    word = psrc >> 5
+                    w = table[word >> 7, word & 127]
+                    src_active = (
+                        ((w >> (psrc & 31)) & 1) > 0
+                    ) & (psrc < n)
                     prop = (
-                        jnp.zeros((n + 1,), jnp.int32)
+                        jnp.zeros((n_pad_nodes + 1,), jnp.int32)
                         .at[pdst]
                         .max(src_active.astype(jnp.int32))
                     )
-                    xla_hits = xla_hits | (prop[:n] > 0)
+                    xla_hits2d = xla_hits2d | (
+                        prop[:n_pad_nodes].reshape(t_rows, LANE) > 0
+                    )
+                    have_xla = True
                     continue
                 if spec[0] == "compact":
                     bmeta1, bmeta2, row_pos, emeta, super_ids = layout_args[
@@ -665,18 +811,22 @@ def _build_trace_fn_multi(
                     pos += 4
                     c = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
                     contrib = contrib + c
-            hits = (contrib.reshape(-1)[:n] > 0) | xla_hits
-            new_mark = mark | (hits & in_use)
-            new_table = pack(new_mark & (~halted))
+            hits2d = contrib > 0
+            if have_xla:
+                hits2d = hits2d | xla_hits2d
+            hit_w = pack2d(hits2d)
+            new_mark_w = mark_w | (hit_w & iu_w)
+            new_table = new_mark_w & nh_w
             d2, l2, changed = dirty_chunks(new_table, table)
-            return new_mark, new_table, d2, l2, changed
+            return new_mark_w, new_table, d2, l2, changed
 
-        table0 = pack(mark0 & (~halted))
+        mark_w0 = pack(mark0)
+        table0 = mark_w0 & nh_w
         d0, l0, changed0 = dirty_chunks(table0, jnp.zeros_like(table0))
-        mark, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (mark0, table0, d0, l0, changed0)
+        mark_w, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (mark_w0, table0, d0, l0, changed0)
         )
-        return mark
+        return unpack(mark_w)
 
     return jax.jit(trace_fn)
 
@@ -749,6 +899,8 @@ def trace_marks_layouts(
                 p["n_super"] == first["n_super"]
                 and p["r_rows"] == first["r_rows"]
                 and p["s_rows"] == first["s_rows"]
+                and p["sub"] == first["sub"]
+                and p["group"] == first["group"]
             ), "layouts must share node-space geometry"
     fn = get_trace_fn_multi(
         n,

@@ -195,6 +195,7 @@ def pack_shard_layouts(
     n_pad: int,
     n_devices: int,
     s_rows: int = None,
+    interpret: bool = None,
 ):
     """Pack propagation pairs into one Pallas layout per destination
     shard, equalized to a common block count and stacked on a leading
@@ -214,6 +215,7 @@ def pack_shard_layouts(
 
     if s_rows is None:
         s_rows = pt.S_ROWS
+    sub, group = pt.default_geometry(interpret)
     super_sz = s_rows * pt.LANE
     shard_size = n_pad // n_devices
     assert n_pad % n_devices == 0 and shard_size % super_sz == 0, (
@@ -234,6 +236,8 @@ def pack_shard_layouts(
             s_rows=s_rows,
             want_slots=True,
             n_src=n_pad,
+            sub=sub,
+            group=group,
         )
         slot_ri = prep.pop("slot_ri")
         slot_col = prep.pop("slot_col")
@@ -256,6 +260,8 @@ def pack_shard_layouts(
         "n_blocks": n_blocks,
         "r_rows": preps[0]["r_rows"],
         "s_rows": s_rows,
+        "sub": sub,
+        "group": group,
     }
     return stacked, meta, slot_vals
 
@@ -270,6 +276,8 @@ def make_sharded_pallas_trace(
     bucket_m: int,
     interpret: bool = None,
     axis: str = "gc",
+    sub: int = None,
+    group: int = None,
 ):
     """The mesh trace with the Pallas propagation kernel per shard.
 
@@ -297,12 +305,18 @@ def make_sharded_pallas_trace(
 
     if interpret is None:
         interpret = pt.default_interpret()
+    if sub is None or group is None:
+        d_sub, d_group = pt.default_geometry(interpret)
+        sub = d_sub if sub is None else sub
+        group = d_group if group is None else group
     super_sz = s_rows * pt.LANE
     n_super_shard = shard_size // super_sz
     propagate = pt.build_propagate(
-        n_blocks, n_super_shard, r_rows, s_rows, interpret
+        n_blocks, n_super_shard, r_rows, s_rows, interpret,
+        sub=sub, group=group,
     )
-    n_chunks = r_rows // pt.ROWS
+    group_rows = pt.ROWS * group
+    n_chunks = r_rows // group_rows
     shard_words = shard_size // pt.WORD_BITS
     words_pad = r_rows * pt.LANE
 
@@ -328,22 +342,38 @@ def make_sharded_pallas_trace(
 
         shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
         chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+        t_local = shard_size // pt.LANE  # contrib rows in this shard
 
-        def pack_table(local_active):
-            w = (
-                local_active.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
+        def pack_words(local_bool):
+            """Pack the (shard_size,) local bool vector into local words
+            (one-time gate/seed packing)."""
+            return (
+                local_bool.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
                 << shifts[None, :]
             ).sum(axis=1, dtype=jnp.int32)
-            w_all = jax.lax.all_gather(w, axis).reshape(-1)
+
+        def pack2d(hits2d):
+            """Local word-pack of (t_local, LANE) hits (contrib layout);
+            see pallas_trace.pack_hits_words for the layout invariant."""
+            return pt.pack_hits_words(hits2d, jnp)
+
+        def gather_table(local_words):
+            """all_gather every shard's active words over ICI and lay
+            them out as the global packed table."""
+            w_all = jax.lax.all_gather(local_words, axis).reshape(-1)
             w_all = jnp.concatenate(
                 [w_all, jnp.zeros((words_pad - w_all.shape[0],), jnp.int32)]
             )
             return w_all.reshape(r_rows, pt.LANE)
 
+        def unpack(local_words):
+            bits = (local_words[:, None] >> shifts[None, :]) & 1
+            return bits.reshape(-1) > 0
+
         def dirty_chunks(table, table_prev):
             diff = (
                 (table != table_prev)
-                .reshape(n_chunks, pt.ROWS * pt.LANE)
+                .reshape(n_chunks, group_rows * pt.LANE)
                 .any(axis=1)
             )
             counts = diff.astype(jnp.int32)
@@ -369,10 +399,12 @@ def make_sharded_pallas_trace(
         def cond(carry):
             return carry[-1]
 
+        iu_w = pack_words(in_use)
+        nh_w = pack_words(~halted)
+
         def body(carry):
-            mark, table, d, l, _ = carry
+            mark_w, table, d, l, _ = carry
             contrib = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
-            hits = contrib.reshape(-1)[:shard_size] > 0
             # insert-bucket tier: global src gather, local scatter-max
             src_active = src_bits(table, bsrc)
             prop = (
@@ -380,18 +412,21 @@ def make_sharded_pallas_trace(
                 .at[bdst]
                 .max(src_active.astype(jnp.int32))
             )
-            hits = hits | (prop[:shard_size] > 0)
-            new_mark = mark | (hits & in_use)
-            new_table = pack_table(new_mark & (~halted))
+            hits2d = (contrib.reshape(t_local, pt.LANE) > 0) | (
+                prop[:shard_size].reshape(t_local, pt.LANE) > 0
+            )
+            new_mark_w = mark_w | (pack2d(hits2d) & iu_w)
+            new_table = gather_table(new_mark_w & nh_w)
             d2, l2, changed = dirty_chunks(new_table, table)
-            return new_mark, new_table, d2, l2, changed
+            return new_mark_w, new_table, d2, l2, changed
 
-        table0 = pack_table(mark0 & (~halted))
+        mark_w0 = pack_words(mark0)
+        table0 = gather_table(mark_w0 & nh_w)
         d0, l0, changed0 = dirty_chunks(table0, jnp.zeros_like(table0))
-        mark, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (mark0, table0, d0, l0, changed0)
+        mark_w, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (mark_w0, table0, d0, l0, changed0)
         )
-        return mark.reshape(1, -1)
+        return unpack(mark_w).reshape(1, -1)
 
     spec_nodes = P(axis)
     spec_dev = P(axis, None)
