@@ -66,3 +66,25 @@ def test_compiled_incremental_mutation_sequence():
     )
     assert layout.stats["rebuilds"] == 1
     assert layout.stats["freezes"] >= 1
+
+
+def test_compiled_decremental_wakes():
+    """The closure+repair wake (dst-gated kernel variant) compiled on
+    hardware, diffed against the from-scratch oracle across churn wakes
+    incl. a released cycle and a halt cascade."""
+    from test_pallas_decremental import OracleGraph, _rand_schedule
+    from uigc_tpu.ops import pallas_decremental as pd
+
+    rng = np.random.default_rng(7)
+    n = 1 << 12
+    g = OracleGraph(rng, n, n_edges=4 * n)
+    tracer = pd.DecrementalTracer(
+        n, interpret=False, freeze_threshold=64, max_frozen=2
+    )
+    src, dst, w, sup = g.arrays()
+    tracer.rebuild(src, dst, w, sup)
+    assert np.array_equal(tracer.marks(g.flags, g.recv), g.oracle_marks())
+    for wake in range(4):
+        _rand_schedule(rng, g, tracer, k=60)
+        got = tracer.marks(g.flags, g.recv)
+        assert np.array_equal(got, g.oracle_marks()), f"wake {wake}"

@@ -39,6 +39,18 @@ def main():
     ap.add_argument("--churn", type=int, default=20_000)
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--no-oracle", action="store_true")
+    ap.add_argument(
+        "--mode",
+        choices=["full", "decremental"],
+        default="full",
+        help=(
+            "full: re-trace to fixpoint from seeds every wake "
+            "(IncrementalPallasLayout.trace_device); decremental: "
+            "closure+repair from the previous fixpoint "
+            "(pallas_decremental.DecrementalTracer) — per-wake cost "
+            "proportional to the churn's affected region"
+        ),
+    )
     args = ap.parse_args()
 
     import jax
@@ -61,11 +73,22 @@ def main():
     recv = graph["recv_count"]
 
     t0 = time.perf_counter()
-    layout = pinc.IncrementalPallasLayout(n)
-    layout.rebuild(
-        graph["edge_src"], graph["edge_dst"], graph["edge_weight"],
-        graph["supervisor"],
-    )
+    if args.mode == "decremental":
+        from uigc_tpu.ops.pallas_decremental import DecrementalTracer
+
+        tracer = DecrementalTracer(n)
+        layout = tracer.layout
+        tracer.rebuild(
+            graph["edge_src"], graph["edge_dst"], graph["edge_weight"],
+            graph["supervisor"],
+        )
+    else:
+        tracer = None
+        layout = pinc.IncrementalPallasLayout(n)
+        layout.rebuild(
+            graph["edge_src"], graph["edge_dst"], graph["edge_weight"],
+            graph["supervisor"],
+        )
     rebuild_s = time.perf_counter() - t0
 
     # Base pair arrays (the churn population) + an oracle weight mask.
@@ -93,8 +116,18 @@ def main():
     flags_dev = jax.device_put(flags)
     recv_dev = jax.device_put(recv)
 
+    if tracer is not None:
+        from uigc_tpu.ops import pallas_trace as pt
+
+        @jax.jit
+        def unpack_marks(words):
+            return pt.unpack_table(words, n, jnp)
+
     def run_wake():
-        mark = layout.trace_device(flags_dev, recv_dev)
+        if tracer is not None:
+            mark = unpack_marks(tracer.wake_device(flags_dev, recv_dev))
+        else:
+            mark = layout.trace_device(flags_dev, recv_dev)
         count, ids = finish(mark, flags_dev)
         return int(count), np.asarray(ids)
 
@@ -153,7 +186,7 @@ def main():
             log_batch.append((True, s, d, 0))
 
         t0 = time.perf_counter()
-        layout.apply_log(log_batch)
+        (tracer or layout).apply_log(log_batch)
         t1 = time.perf_counter()
         count, ids = run_wake()
         t2 = time.perf_counter()
@@ -181,6 +214,7 @@ def main():
     p50 = statistics.median(wake_ms)
     result = {
         "bench": "per_wake_detection",
+        "mode": args.mode,
         "n_actors": n,
         "n_pairs": int(layout.base["n_pairs"]),
         "wakes": args.wakes,

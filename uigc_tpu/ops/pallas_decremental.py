@@ -1,0 +1,385 @@
+"""Decremental per-wake garbage detection: suspect closure + region repair.
+
+The full trace re-derives reachability from the seeds every wake — ~12
+propagation sweeps over a 10M-actor graph even when the wake's churn
+touched a few thousand nodes.  The reference never faces this regime (its
+collector traces ~10^4-10^5 node-local shadows per 50ms wake,
+LocalGC.scala:144-186); at BASELINE.md's 10M-actor scale the <=10ms p50
+detection target is unreachable by full re-trace (PERF_WAKE.md).  Marks
+do not shrink monotonically under churn — releasing a ref can turn live
+actors into garbage — so a sound incremental wake must re-derive exactly
+the region whose old derivation might have depended on what changed.
+
+Per wake, relative to the previous fixpoint:
+
+1. **Suspect seeds** ``S``: nodes whose mark derivation inputs may have
+   shrunk — destinations of deleted propagation pairs, previously-seed
+   nodes that stopped seeding (busy cleared, recv drained, root dropped),
+   and newly-halted nodes (their out-edges stop propagating) — all
+   intersected with the previous marks (an unmarked node has nothing to
+   invalidate).
+2. **Closure**: the forward closure of ``S`` through the current layout,
+   restricted to previously-marked nodes — every mark that transitively
+   depended on a suspect.  A monotone fixpoint, so the source-side
+   dirty-group machinery bounds its cost by the region size.
+3. **Repair**: clear the closure's marks, reseed from the current seed
+   vector, and run the propagation fixpoint where the FIRST sweep forces
+   blocks whose output supertile intersects the closure to walk their
+   full chunk span (``build_propagate(dst_gate=True)``) — those
+   supertiles must re-derive contributions from ALL in-edges, including
+   sources whose table groups never changed.  Later sweeps are monotone
+   growth and fall back to the ordinary dirty-group walk.
+
+Soundness: a previously-marked node outside the closure retains a support
+path untouched by any deletion, de-seeding, or halt (otherwise some node
+on the path would have entered ``S`` and pushed the rest into the
+closure), so its mark stays valid; closure members are re-derived from
+scratch against that stable boundary.  Additions (new pairs, new seeds)
+ride the same repair fixpoint through the ordinary monotone machinery.
+A cold start degenerates gracefully: with zero previous state the suspect
+set is empty and the repair fixpoint IS the full trace from seeds.
+
+Differential coverage: tests/test_pallas_decremental.py drives random
+mutation/flag-change schedules and compares every wake against the numpy
+oracle re-run from scratch (trace_marks_np, the reference semantics of
+ShadowGraph.java:205-289).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from . import pallas_trace as pt
+from . import trace as trace_ops
+from .pallas_incremental import IncrementalPallasLayout
+
+_fn_cache: Dict[tuple, object] = {}
+
+
+def _build_wake_fn(
+    n: int,
+    specs: tuple,
+    n_super: int,
+    r_rows: int,
+    s_rows: int,
+    interpret: bool,
+):
+    """The jitted wake: (flags, recv, del_words, fresh_words, prev
+    state, *layout args) -> (mark_w, seed_w, halted_w, iu_w, table) with
+    all word tables (r_rows, LANE) int32 device arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    F = trace_ops
+
+    geoms = {spec[-2:] for spec in specs if spec[0] != "xla"}
+    assert len(geoms) == 1, "packed layouts must share (sub, group)"
+    ((_, group),) = geoms
+    group_rows = pt.ROWS * group
+
+    # One dst-gated kernel per packed layout serves both phases: a zero
+    # gate vector makes it behave exactly like the plain kernel.
+    gated = pt.build_layout_propagates(
+        specs, n_super, r_rows, s_rows, interpret, dst_gate=True
+    )
+
+    n_chunks = r_rows // group_rows
+    n_pad_nodes = n_super * s_rows * pt.LANE
+    t_rows = n_super * s_rows
+    sup_words = s_rows * (pt.LANE // pt.WORD_BITS)  # words per supertile
+
+    def wake_fn(flags, recv_count, del_w, fresh_w, prev_mark_w,
+                prev_seed_w, prev_halted_w, prev_iu_w, prev_table,
+                *layout_args):
+        in_use = (flags & F.FLAG_IN_USE) != 0
+        halted = (flags & F.FLAG_HALTED) != 0
+        seed = (
+            ((flags & F.FLAG_ROOT) != 0)
+            | ((flags & F.FLAG_BUSY) != 0)
+            | (recv_count != 0)
+            | ((flags & F.FLAG_INTERNED) == 0)
+        )
+
+        def pack(active):
+            return pt.pack_bools(active, n, r_rows, jnp)
+
+        def dirty_chunks(table, table_prev):
+            return pt.dirty_group_lists(
+                table, table_prev, n_chunks, group_rows, jnp
+            )
+
+        gated_sweep = pt.build_sweep_contribs(
+            specs, gated, n, n_super, s_rows, jnp
+        )
+
+        def contribs(table, d, l, suspect_g, use_gate):
+            """One propagation sweep over every layout (shared loop:
+            pallas_trace.build_sweep_contribs).  The gate vector is
+            zeroed when use_gate is False, which makes the dst-gated
+            kernels behave exactly like the plain ones."""
+            gate = jnp.where(use_gate, suspect_g, jnp.zeros_like(suspect_g))
+            return gated_sweep(table, d, l, layout_args, gate=gate)
+
+        iu_w = pack(in_use)
+        nh_w = pack(~halted)
+        halted_w = pack(halted)
+        seed_w = pack(in_use & (~halted) & seed)
+
+        # --- 1. suspect seeds --------------------------------------- #
+        # A previously-marked node is suspect when any input of its old
+        # derivation may have shrunk: it was freed (in_use dropped — the
+        # oracle gates marks on in_use, so the mark itself must go), it
+        # newly halted (stops propagating), it stopped seeding, or an
+        # in-edge was deleted.
+        s_w = (
+            (~iu_w)
+            | (halted_w & ~prev_halted_w)
+            | (prev_seed_w & ~seed_w)
+            | del_w
+        ) & prev_mark_w
+
+        # --- 2. closure: marks that depended on a suspect ----------- #
+        def c_cond(carry):
+            return carry[-1]
+
+        def c_body(carry):
+            closure_w, d, l, _ = carry
+            hits2d = contribs(
+                closure_w, d, l, jnp.zeros((n_super,), jnp.int32), False
+            )
+            hit_w = pt.pack_hits_table(hits2d, r_rows, jnp)
+            new_closure = closure_w | (hit_w & prev_mark_w)
+            d2, l2, changed = dirty_chunks(new_closure, closure_w)
+            return new_closure, d2, l2, changed
+
+        d0, l0, changed0 = dirty_chunks(s_w, jnp.zeros_like(s_w))
+        closure_w, _, _, _ = jax.lax.while_loop(
+            c_cond, c_body, (s_w, d0, l0, changed0)
+        )
+
+        # per-supertile gate: closure members must re-derive; fresh
+        # insert destinations must see their new pairs' contributions at
+        # least once (a new edge changes no node word, so the dirty
+        # machinery alone would never walk it — and a pair frozen into a
+        # packed tier before its first propagation would otherwise be
+        # skipped forever).  Gating only ADDS contributions, so it is
+        # monotone-safe.
+        def per_super(words):
+            return (
+                words.reshape(-1)[: n_super * sup_words]
+                .reshape(n_super, sup_words)
+                .any(axis=1)
+                .astype(jnp.int32)
+            )
+
+        # Newly-in-use nodes (slot reuse) are the additive mirror of the
+        # fresh-insert case: reachable but with no word change anywhere,
+        # so their supertile must re-derive once to pick the mark up.
+        suspect_g = (
+            per_super(closure_w)
+            | per_super(fresh_w)
+            | per_super(iu_w & ~prev_iu_w)
+        )
+
+        # --- 3. repair fixpoint ------------------------------------- #
+        mark_w0 = (prev_mark_w & ~closure_w) | seed_w
+        table0 = mark_w0 & nh_w
+        rd0, rl0, rchanged0 = dirty_chunks(table0, prev_table)
+
+        def r_cond(carry):
+            return carry[-1]
+
+        def r_body(carry):
+            mark_w, table, d, l, use_gate, _ = carry
+            hits2d = contribs(table, d, l, suspect_g, use_gate)
+            hit_w = pt.pack_hits_table(hits2d, r_rows, jnp)
+            new_mark_w = mark_w | (hit_w & iu_w)
+            new_table = new_mark_w & nh_w
+            d2, l2, changed = dirty_chunks(new_table, table)
+            # The gated sweep fully re-derives suspect supertiles; the
+            # monotone dirty machinery is sufficient (and cheaper) after.
+            return new_mark_w, new_table, d2, l2, jnp.array(False), changed
+
+        # Run at least one gated sweep whenever anything is suspect,
+        # even if the table diff alone is empty.
+        run0 = rchanged0 | (suspect_g.sum() > 0)
+        mark_w, table, _, _, _, _ = jax.lax.while_loop(
+            r_cond,
+            r_body,
+            (mark_w0, table0, rd0, rl0, jnp.array(True), run0),
+        )
+        return mark_w, seed_w, halted_w, iu_w, table
+
+    return jax.jit(wake_fn)
+
+
+def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None):
+    if interpret is None:
+        interpret = pt.default_interpret()
+    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret)
+    fn = _fn_cache.get(key)
+    if fn is None:
+        fn = _fn_cache[key] = _build_wake_fn(
+            n, tuple(specs), n_super, r_rows, s_rows, interpret
+        )
+    return fn
+
+
+class DecrementalTracer:
+    """Per-wake detection state on top of IncrementalPallasLayout.
+
+    Owns the device-resident previous-fixpoint words (marks, seeds,
+    halted/in-use bits, active table) and the deleted-destination set gathered
+    from the mutation log, and runs the closure+repair wake.  The first
+    wake (or any wake after the previous state was invalidated) runs the
+    full derivation through the same code path.
+    """
+
+    def __init__(self, n: int, interpret: Optional[bool] = None, **kwargs):
+        self.layout = IncrementalPallasLayout(n, interpret=interpret, **kwargs)
+        self.n = n
+        self.interpret = interpret
+        self._mark_w = None
+        self._seed_w = None
+        self._halted_w = None
+        self._iu_w = None
+        self._table = None
+        self._pending_del_dst: Set[int] = set()
+        self._pending_fresh_dst: Set[int] = set()
+        self._unpack = None
+        self._zeros = None
+
+    # -- building / mutation (layout pass-throughs that watch removals) --
+
+    def rebuild(self, edge_src, edge_dst, edge_weight, supervisor) -> None:
+        """Full repack from graph arrays.  The previous fixpoint is
+        invalidated: a rebuild may drop pairs that never went through
+        remove()/apply_log(), so the next wake re-derives everything (the
+        zero prev-state path)."""
+        self.layout.rebuild(edge_src, edge_dst, edge_weight, supervisor)
+        self._mark_w = self._seed_w = self._halted_w = None
+        self._iu_w = self._table = None
+        self._pending_del_dst.clear()
+        self._pending_fresh_dst.clear()
+
+    def insert(self, src: int, dst: int, kind: int) -> None:
+        if dst < self.n:
+            self._pending_fresh_dst.add(int(dst))
+        self.layout.insert(src, dst, kind)
+
+    def remove(self, src: int, dst: int, kind: int) -> None:
+        if dst < self.n:
+            self._pending_del_dst.add(int(dst))
+        self.layout.remove(src, dst, kind)
+
+    def apply_log(self, log: List[tuple]) -> None:
+        for ins, _src, dst, _kind in log:
+            # Over-approximation is sound: a removal that nets out (or
+            # hits a never-propagated pending pair) adds a suspect whose
+            # repair is a no-op; an insert dst only forces one full
+            # re-derivation of its supertile.
+            if dst < self.n:
+                (self._pending_fresh_dst if ins else self._pending_del_dst).add(
+                    int(dst)
+                )
+        self.layout.apply_log(log)
+
+    # -- the wake ------------------------------------------------------ #
+
+    def _id_words(self, id_set: Set[int], r_rows: int):
+        # Scatter an id set into a packed word table (device).  The set
+        # is NOT drained here: a wake whose dispatch raises (compile
+        # error, immediate transport error) keeps its suspects for the
+        # retry; wake_device clears them only after dispatch succeeds.
+        # An async-poisoned result (error surfacing at readback) loses
+        # the device state itself — the caller recovers via
+        # invalidate(), after which suspects are irrelevant.
+        import jax
+
+        if not id_set:
+            if self._zeros is None or self._zeros.shape[0] != r_rows:
+                self._zeros = jax.device_put(
+                    np.zeros((r_rows, pt.LANE), np.int32)
+                )
+            return self._zeros
+        ids = np.fromiter(id_set, np.int64, len(id_set))
+        words = np.zeros(r_rows * pt.LANE, dtype=np.uint32)
+        np.bitwise_or.at(
+            words, ids >> 5, np.uint32(1) << (ids & 31).astype(np.uint32)
+        )
+        return jax.device_put(words.view(np.int32).reshape(r_rows, pt.LANE))
+
+    def wake_device(self, flags_dev, recv_dev):
+        """Run one wake; returns the packed mark words (device).  Use
+        :meth:`marks` for the boolean vector."""
+        import jax
+
+        preps, args = self.layout.prepare_device_wake()
+        first = preps[0]
+        r_rows = first["r_rows"]
+        fn = get_wake_fn(
+            self.n,
+            tuple(pt.layout_spec(p) for p in preps),
+            first["n_super"],
+            r_rows,
+            first["s_rows"],
+            self.interpret,
+        )
+        if self._mark_w is None or self._mark_w.shape[0] != r_rows:
+            z = jax.device_put(np.zeros((r_rows, pt.LANE), np.int32))
+            self._mark_w = self._seed_w = self._halted_w = z
+            self._iu_w = self._table = z
+            # every previous mark is gone: everything must re-derive,
+            # which the zero prev-state does for free (empty suspects,
+            # full seed-diff dirty set)
+        del_w = self._id_words(self._pending_del_dst, r_rows)
+        fresh_w = self._id_words(self._pending_fresh_dst, r_rows)
+        out = fn(
+            flags_dev,
+            recv_dev,
+            del_w,
+            fresh_w,
+            self._mark_w,
+            self._seed_w,
+            self._halted_w,
+            self._iu_w,
+            self._table,
+            *args,
+        )
+        # State + suspects commit when dispatch succeeds.  Under async
+        # dispatch a transport death can still poison the returned
+        # arrays at first readback — after any such failure the caller
+        # must invalidate() (the previous fixpoint is lost with the
+        # device state anyway), which makes the next wake a full
+        # re-derivation and the drained suspects irrelevant.
+        self._mark_w, self._seed_w, self._halted_w, self._iu_w, self._table = out
+        self._pending_del_dst.clear()
+        self._pending_fresh_dst.clear()
+        return self._mark_w
+
+    def invalidate(self) -> None:
+        """Drop the previous-fixpoint device state (after a failed or
+        poisoned wake, or any external doubt about it): the next wake
+        re-derives everything from the current seeds."""
+        self._mark_w = self._seed_w = self._halted_w = None
+        self._iu_w = self._table = None
+        self._pending_del_dst.clear()
+        self._pending_fresh_dst.clear()
+
+    def marks(self, flags, recv_count) -> np.ndarray:
+        """Wake + unpack to the oracle's (n,) bool mark vector."""
+        import jax
+        import jax.numpy as jnp
+
+        mark_w = self.wake_device(jax.device_put(flags), jax.device_put(recv_count))
+
+        if self._unpack is None:
+
+            @jax.jit
+            def unpack(words):
+                return pt.unpack_table(words, self.n, jnp)
+
+            self._unpack = unpack
+        return np.asarray(self._unpack(mark_w))

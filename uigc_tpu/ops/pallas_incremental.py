@@ -70,13 +70,19 @@ class IncrementalPallasLayout:
         freeze_threshold: int = 1 << 14,
         max_frozen: int = 4,
         interpret: Optional[bool] = None,
+        sub: Optional[int] = None,
+        group: Optional[int] = None,
     ):
         self.n = n
         self.s_rows = s_rows
         # Pin the kernel walk geometry once: base and delta tiers must
         # agree (they share one trace), and a mid-life platform change
-        # must not silently mix geometries.
-        self.sub, self.group = pt.default_geometry(interpret)
+        # must not silently mix geometries.  Explicit sub/group override
+        # the platform default (tests cover the wide geometry in
+        # interpret mode this way).
+        d_sub, d_group = pt.default_geometry(interpret)
+        self.sub = d_sub if sub is None else sub
+        self.group = d_group if group is None else group
         self.repack_fraction = repack_fraction
         self.min_repack = min_repack
         self.freeze_threshold = freeze_threshold
@@ -472,21 +478,12 @@ class IncrementalPallasLayout:
             out.append(mirror["super_ids"])
         return out
 
-    def trace_device(self, flags_dev, recv_dev):
-        """Like :meth:`trace`, but every packed layout's operand arrays
-        stay device-resident between wakes (the reference's steady state:
-        LocalGC.scala:144-186 never re-ships its graph per wake) and the
-        mark vector is returned as a device array, so callers can reduce
-        garbage counts/ids on device instead of pulling 10M bools."""
+    def prepare_device_wake(self):
+        """prepare_wake + device-operand assembly + mirror GC: the
+        device-resident wake entry shared by :meth:`trace_device` and the
+        decremental tracer (ops/pallas_decremental.py).  Returns
+        (preps, args)."""
         preps = self.prepare_wake()
-        fn = pt.get_trace_fn_multi(
-            self.n,
-            tuple(pt.layout_spec(p) for p in preps),
-            preps[0]["n_super"],
-            preps[0]["r_rows"],
-            preps[0]["s_rows"],
-            self.interpret,
-        )
         args = []
         for p in preps:
             args.extend(self._device_args(p))
@@ -497,4 +494,21 @@ class IncrementalPallasLayout:
             if pid not in live_tokens:
                 del self._dev_mirror[pid]
                 self._dev_writes.pop(pid, None)
+        return preps, args
+
+    def trace_device(self, flags_dev, recv_dev):
+        """Like :meth:`trace`, but every packed layout's operand arrays
+        stay device-resident between wakes (the reference's steady state:
+        LocalGC.scala:144-186 never re-ships its graph per wake) and the
+        mark vector is returned as a device array, so callers can reduce
+        garbage counts/ids on device instead of pulling 10M bools."""
+        preps, args = self.prepare_device_wake()
+        fn = pt.get_trace_fn_multi(
+            self.n,
+            tuple(pt.layout_spec(p) for p in preps),
+            preps[0]["n_super"],
+            preps[0]["r_rows"],
+            preps[0]["s_rows"],
+            self.interpret,
+        )
         return fn(flags_dev, recv_dev, *args)

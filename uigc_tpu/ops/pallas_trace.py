@@ -116,6 +116,36 @@ def pack_hits_words(hits2d, jnp):
     return w.reshape(-1)
 
 
+def pack_bools(active, n, r_rows, jnp):
+    """Scatter-pack an (n,) bool vector into the (r_rows, LANE) word
+    table (bits >= n stay 0).  O(n) — used once per trace for seed/gate
+    vectors; the fixpoint's per-sweep pack is pack_hits_table."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)
+    a = jnp.zeros(r_rows * LANE * WORD_BITS, jnp.int32)
+    a = a.at[:n].set(active.astype(jnp.int32))
+    w = (a.reshape(-1, WORD_BITS) << shifts[None, :]).sum(
+        axis=1, dtype=jnp.int32
+    )
+    return w.reshape(r_rows, LANE)
+
+
+def dirty_group_lists(table, table_prev, n_chunks, group_rows, jnp):
+    """Prefix D and compacted index list L of the walk groups whose words
+    changed — the kernel ABI build_propagate consumes (D sized
+    n_chunks+1, L sized n_chunks, plus the any-changed flag)."""
+    chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
+    diff = (
+        (table != table_prev).reshape(n_chunks, group_rows * LANE).any(axis=1)
+    )
+    counts = diff.astype(jnp.int32)
+    d = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)])
+    pos = jnp.where(diff, d[:-1], n_chunks)
+    l = (
+        jnp.zeros((n_chunks + 1,), jnp.int32).at[pos].set(chunk_ids)[:n_chunks]
+    )
+    return d, l, d[n_chunks] > 0
+
+
 def pack_hits_table(hits2d, r_rows, jnp):
     """pack_hits_words padded and reshaped into the (r_rows, LANE) word
     table — the exact per-sweep pack on the fixpoint path (trace_fn's
@@ -125,6 +155,89 @@ def pack_hits_table(hits2d, r_rows, jnp):
         [flat, jnp.zeros((r_rows * LANE - flat.shape[0],), jnp.int32)]
     )
     return flat.reshape(r_rows, LANE)
+
+
+def unpack_table(words, n, jnp):
+    """Unpack the (r_rows, LANE) word table back to an (n,) bool vector
+    (inverse of pack_bools/pack_hits_table for bits < n)."""
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)
+    bits = (words.reshape(-1)[:, None] >> shifts[None, :]) & 1
+    return bits.reshape(-1)[:n] > 0
+
+
+def build_sweep_contribs(specs, propagates, n, n_super, s_rows, jnp):
+    """The per-layout propagation sweep shared by the full trace and the
+    decremental wake: returns fn(table, d, l, layout_args, gate) -> hits
+    plane (t_rows, LANE) bool.
+
+    ``propagates`` holds one kernel per packed spec (None for xla
+    tiers).  ``gate`` is the per-global-supertile dst-gate vector for
+    dst_gate=True kernels, or None when the kernels were built without a
+    gate operand.  Keeping this loop in one place is what guarantees the
+    two fixpoints propagate identically per sweep — the parity the
+    differential tests rely on."""
+    t_rows = n_super * s_rows
+    n_pad_nodes = t_rows * LANE
+    sub_iota_rows = jnp.arange(s_rows, dtype=jnp.int32)
+
+    def sweep(table, d, l, layout_args, gate=None):
+        contrib = jnp.zeros((t_rows, LANE), jnp.float32)
+        xla_hits2d = jnp.zeros((t_rows, LANE), bool)
+        have_xla = False
+        pos = 0
+        for spec, propagate in zip(specs, propagates):
+            if spec[0] == "xla":
+                psrc, pdst = layout_args[pos:pos + 2]
+                pos += 2
+                # Source-active bits gathered straight from the packed
+                # table; sink pads (src = n) masked out.
+                word = psrc >> 5
+                w = table[word >> 7, word & 127]
+                src_active = (((w >> (psrc & 31)) & 1) > 0) & (psrc < n)
+                prop = (
+                    jnp.zeros((n_pad_nodes + 1,), jnp.int32)
+                    .at[pdst]
+                    .max(src_active.astype(jnp.int32))
+                )
+                xla_hits2d = xla_hits2d | (
+                    prop[:n_pad_nodes].reshape(t_rows, LANE) > 0
+                )
+                have_xla = True
+                continue
+            if spec[0] == "compact":
+                bmeta1, bmeta2, row_pos, emeta, super_ids = layout_args[
+                    pos:pos + 5
+                ]
+                pos += 5
+                if gate is None:
+                    c = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
+                else:
+                    c = propagate(
+                        d, l, gate[super_ids], bmeta1, bmeta2, table,
+                        row_pos, emeta,
+                    )
+                rows = (
+                    super_ids[:, None] * s_rows + sub_iota_rows[None, :]
+                ).reshape(-1)
+                contrib = contrib.at[rows].add(
+                    c, mode="drop", unique_indices=False
+                )
+            else:
+                bmeta1, bmeta2, row_pos, emeta = layout_args[pos:pos + 4]
+                pos += 4
+                if gate is None:
+                    c = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
+                else:
+                    c = propagate(
+                        d, l, gate, bmeta1, bmeta2, table, row_pos, emeta
+                    )
+                contrib = contrib + c
+        hits2d = contrib > 0
+        if have_xla:
+            hits2d = hits2d | xla_hits2d
+        return hits2d
+
+    return sweep
 
 
 def default_geometry(interpret: bool | None = None) -> tuple:
@@ -487,6 +600,33 @@ def layout_spec(prep: Dict[str, np.ndarray]) -> tuple:
     return ("dense", prep["n_blocks"], prep["sub"], prep["group"])
 
 
+def build_layout_propagates(
+    specs, n_super, r_rows, s_rows, interpret, dst_gate=False
+):
+    """One propagation kernel per packed layout spec (None for xla
+    tiers) — the builder loop shared by the full trace and the
+    decremental wake."""
+    out = []
+    for spec in specs:
+        if spec[0] == "dense":
+            out.append(
+                build_propagate(
+                    spec[1], n_super, r_rows, s_rows, interpret,
+                    sub=spec[2], group=spec[3], dst_gate=dst_gate,
+                )
+            )
+        elif spec[0] == "compact":
+            out.append(
+                build_propagate(
+                    spec[1], spec[2], r_rows, s_rows, interpret,
+                    sub=spec[3], group=spec[4], dst_gate=dst_gate,
+                )
+            )
+        else:
+            out.append(None)
+    return out
+
+
 def build_propagate(
     n_blocks: int,
     out_tiles: int,
@@ -495,6 +635,7 @@ def build_propagate(
     interpret: bool,
     sub: int = None,
     group: int = None,
+    dst_gate: bool = False,
 ):
     """One propagation sweep as a pallas_call: gather source bits from the
     packed table, one-hot segment-sum into per-supertile contributions.
@@ -508,6 +649,15 @@ def build_propagate(
     under the trace's monotone OR-accumulation: a clean chunk's words are
     unchanged since the sweep that last walked them, so the skipped
     contribution is already in the mark vector.
+
+    With ``dst_gate`` a fifth scalar-prefetch operand S (one int per
+    output tile, 0/1) forces blocks whose output tile is flagged to walk
+    their FULL chunk span regardless of the dirty lists.  The decremental
+    wake's repair pass needs this: after unmarking a suspect region, the
+    region's supertiles must re-derive their contributions from ALL their
+    in-edges — including sources whose table groups did not change —
+    which the source-side dirty machinery cannot express
+    (ops/pallas_decremental.py).
     """
     import jax
     import jax.numpy as jnp
@@ -522,8 +672,12 @@ def build_propagate(
     group_rows = ROWS * group
 
     def kernel(*refs):
-        d_ref, l_ref, meta1_ref, meta2_ref = refs[:4]
-        table_ref, row_ref, emeta_ref, out_ref = refs[4:]
+        if dst_gate:
+            d_ref, l_ref, s_ref, meta1_ref, meta2_ref = refs[:5]
+            table_ref, row_ref, emeta_ref, out_ref = refs[5:]
+        else:
+            d_ref, l_ref, meta1_ref, meta2_ref = refs[:4]
+            table_ref, row_ref, emeta_ref, out_ref = refs[4:]
         i = pl.program_id(0)
         m2 = meta2_ref[i]
         c_lo = jax.lax.shift_right_logical(m2, _SPAN_BITS)
@@ -532,13 +686,20 @@ def build_propagate(
 
         j_lo = d_ref[c_lo]
         j_hi = d_ref[c_lo + span]
+        if dst_gate:
+            gated = s_ref[meta1_ref[i] >> 1] > 0
+            n_iter = jnp.where(gated, span, j_hi - j_lo)
+            l_cap = l_ref.shape[0] - 1
+        else:
+            gated = None
+            n_iter = j_hi - j_lo
 
         row_iota = jax.lax.broadcasted_iota(jnp.int32, (block_rows, LANE), 0)
         r8_iota = row_iota & 7  # slot row class = src row mod 8
         sub_iota = jax.lax.broadcasted_iota(jnp.int32, (s_rows, LANE), 0)
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (LANE, LANE), 1)
 
-        @pl.when(j_hi > j_lo)
+        @pl.when(n_iter > 0)
         def _():
             row_pos = row_ref[:]
             emeta = emeta_ref[:]
@@ -551,7 +712,14 @@ def build_propagate(
                 # One iteration walks a group_rows-row table group:
                 # `group` statically-unrolled sub-gathers, each matching
                 # slots whose source row falls in that 8-row sub-chunk.
-                c = l_ref[j]
+                if dst_gate:
+                    # Gated blocks walk the plain span; ungated blocks
+                    # the compacted dirty list (clamped load: the list
+                    # value is unused when gated).
+                    lc = l_ref[jnp.minimum(j_lo + j, l_cap)]
+                    c = jnp.where(gated, c_lo + j, lc)
+                else:
+                    c = l_ref[j_lo + j]
                 tab_g = table_ref[pl.ds(c * group_rows, group_rows), :]
                 base = c * group_rows
                 for s in range(group):
@@ -569,8 +737,8 @@ def build_propagate(
                 return acc
 
             words = jax.lax.fori_loop(
-                j_lo,
-                j_hi,
+                0,
+                n_iter,
                 chunk_body,
                 jnp.zeros((block_rows, LANE), jnp.int32),
             )
@@ -607,7 +775,7 @@ def build_propagate(
             def _():
                 out_ref[:] = out_ref[:] + acc
 
-        @pl.when(jnp.logical_not(j_hi > j_lo) & first)
+        @pl.when(jnp.logical_not(n_iter > 0) & first)
         def _():
             out_ref[:] = jnp.zeros((s_rows, LANE), jnp.float32)
 
@@ -617,12 +785,19 @@ def build_propagate(
     def imap_table(i, *_meta):
         return (0, 0)
 
-    def imap_out(i, d, l, m1, m2):
-        return (m1[i] >> 1, 0)
+    if dst_gate:
+
+        def imap_out(i, d, l, sg, m1, m2):
+            return (m1[i] >> 1, 0)
+
+    else:
+
+        def imap_out(i, d, l, m1, m2):
+            return (m1[i] >> 1, 0)
 
     blockmap = pl.BlockSpec((block_rows, LANE), imap_block)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=5 if dst_gate else 4,
         grid=(n_blocks,),
         in_specs=[
             # bit table: whole array, VMEM-resident across all steps
@@ -676,24 +851,9 @@ def _build_trace_fn_multi(
     ((_, group),) = geoms
     group_rows = ROWS * group
 
-    propagates = []
-    for spec in specs:
-        if spec[0] == "dense":
-            propagates.append(
-                build_propagate(
-                    spec[1], n_super, r_rows, s_rows, interpret,
-                    sub=spec[2], group=spec[3],
-                )
-            )
-        elif spec[0] == "compact":
-            propagates.append(
-                build_propagate(
-                    spec[1], spec[2], r_rows, s_rows, interpret,
-                    sub=spec[3], group=spec[4],
-                )
-            )
-        else:  # xla tier: no kernel
-            propagates.append(None)
+    propagates = build_layout_propagates(
+        specs, n_super, r_rows, s_rows, interpret
+    )
 
     n_words_pad = r_rows * LANE
     n_chunks = r_rows // group_rows  # dirty granularity = one walk group
@@ -711,55 +871,25 @@ def _build_trace_fn_multi(
         )
         mark0 = in_use & (~halted) & seed
 
-        shifts = jnp.arange(WORD_BITS, dtype=jnp.int32)
-        chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
-
         def pack(active):
-            """Pack an (n,) bool vector into the (r_rows, LANE) word
-            table.  Used once per trace for the seed/gate vectors; the
-            fixpoint itself stays in word space (pack2d)."""
-            a = jnp.zeros(n_words_pad * WORD_BITS, jnp.int32)
-            a = a.at[:n].set(active.astype(jnp.int32))
-            w = (a.reshape(-1, WORD_BITS) << shifts[None, :]).sum(
-                axis=1, dtype=jnp.int32
-            )
-            return w.reshape(r_rows, LANE)
+            return pack_bools(active, n, r_rows, jnp)
 
         def pack2d(hits2d):
-            """Pack per-sweep hits — already laid out (t_rows, LANE),
-            the contrib layout — into the word table without leaving
-            word space: O(n/32) output instead of the O(n) scatter+shift
+            """Per-sweep word-space pack of the (t_rows, LANE) hits
+            plane: O(n/32) output instead of the O(n) scatter+shift
             repack of the bool-space pack."""
             return pack_hits_table(hits2d, r_rows, jnp)
 
         def unpack(words):
-            bits = (words.reshape(-1)[:, None] >> shifts[None, :]) & 1
-            return bits.reshape(-1)[:n] > 0
+            return unpack_table(words, n, jnp)
 
         def dirty_chunks(table, table_prev):
-            """Prefix D and compacted index list L of the chunks whose
-            words changed — the frontier the next sweep must walk."""
-            diff = (
-                (table != table_prev)
-                .reshape(n_chunks, group_rows * LANE)
-                .any(axis=1)
-            )
-            counts = diff.astype(jnp.int32)
-            d = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
-            )
-            pos = jnp.where(diff, d[:-1], n_chunks)
-            l = (
-                jnp.zeros((n_chunks + 1,), jnp.int32)
-                .at[pos]
-                .set(chunk_ids)[:n_chunks]
-            )
-            return d, l, d[n_chunks] > 0
+            return dirty_group_lists(table, table_prev, n_chunks, group_rows, jnp)
 
         def cond(carry):
             return carry[-1]
 
-        sub_iota_rows = jnp.arange(s_rows, dtype=jnp.int32)
+        sweep = build_sweep_contribs(specs, propagates, n, n_super, s_rows, jnp)
 
         # Gate tables: in_use bits (mark gating) and ~halted bits
         # (propagation gating).  pack() only sets bits < n, so padding
@@ -769,51 +899,7 @@ def _build_trace_fn_multi(
 
         def body(carry):
             mark_w, table, d, l, _ = carry
-            contrib = jnp.zeros((t_rows, LANE), jnp.float32)
-            xla_hits2d = jnp.zeros((t_rows, LANE), bool)
-            have_xla = False
-            pos = 0
-            for idx, (spec, propagate) in enumerate(zip(specs, propagates)):
-                if spec[0] == "xla":
-                    psrc, pdst = layout_args[pos : pos + 2]
-                    pos += 2
-                    # Source-active bits gathered straight from the
-                    # packed table; sink pads (src = n) masked out.
-                    word = psrc >> 5
-                    w = table[word >> 7, word & 127]
-                    src_active = (
-                        ((w >> (psrc & 31)) & 1) > 0
-                    ) & (psrc < n)
-                    prop = (
-                        jnp.zeros((n_pad_nodes + 1,), jnp.int32)
-                        .at[pdst]
-                        .max(src_active.astype(jnp.int32))
-                    )
-                    xla_hits2d = xla_hits2d | (
-                        prop[:n_pad_nodes].reshape(t_rows, LANE) > 0
-                    )
-                    have_xla = True
-                    continue
-                if spec[0] == "compact":
-                    bmeta1, bmeta2, row_pos, emeta, super_ids = layout_args[
-                        pos : pos + 5
-                    ]
-                    pos += 5
-                    c = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
-                    rows = (
-                        super_ids[:, None] * s_rows + sub_iota_rows[None, :]
-                    ).reshape(-1)
-                    contrib = contrib.at[rows].add(
-                        c, mode="drop", unique_indices=False
-                    )
-                else:
-                    bmeta1, bmeta2, row_pos, emeta = layout_args[pos : pos + 4]
-                    pos += 4
-                    c = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
-                    contrib = contrib + c
-            hits2d = contrib > 0
-            if have_xla:
-                hits2d = hits2d | xla_hits2d
+            hits2d = sweep(table, d, l, layout_args)
             hit_w = pack2d(hits2d)
             new_mark_w = mark_w | (hit_w & iu_w)
             new_table = new_mark_w & nh_w

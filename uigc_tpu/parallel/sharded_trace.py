@@ -341,7 +341,6 @@ def make_sharded_pallas_trace(
         mark0 = in_use & (~halted) & seed
 
         shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
-        chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32)
         t_local = shard_size // pt.LANE  # contrib rows in this shard
 
         def pack_words(local_bool):
@@ -371,22 +370,9 @@ def make_sharded_pallas_trace(
             return bits.reshape(-1) > 0
 
         def dirty_chunks(table, table_prev):
-            diff = (
-                (table != table_prev)
-                .reshape(n_chunks, group_rows * pt.LANE)
-                .any(axis=1)
+            return pt.dirty_group_lists(
+                table, table_prev, n_chunks, group_rows, jnp
             )
-            counts = diff.astype(jnp.int32)
-            d = jnp.concatenate(
-                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)]
-            )
-            pos = jnp.where(diff, d[:-1], n_chunks)
-            l = (
-                jnp.zeros((n_chunks + 1,), jnp.int32)
-                .at[pos]
-                .set(chunk_ids)[:n_chunks]
-            )
-            return d, l, d[n_chunks] > 0
 
         def src_bits(table, src):
             """Gather global source active bits from the packed table.
