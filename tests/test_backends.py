@@ -70,7 +70,10 @@ class Root(AbstractBehavior):
 from conftest import NATIVE_BACKEND
 
 
-@pytest.mark.parametrize("backend", ["oracle", "array", "device", "mesh", NATIVE_BACKEND])
+@pytest.mark.parametrize(
+    "backend",
+    ["oracle", "array", "device", "mesh", "decremental", NATIVE_BACKEND],
+)
 def test_cycle_collection_all_backends(backend):
     kit = ActorTestKit(
         {"uigc.crgc.wakeup-interval": 10, "uigc.crgc.shadow-graph": backend}

@@ -141,9 +141,16 @@ def random_actor_factory(shared):
     return Behaviors.setup(lambda ctx: RandomActor(ctx, shared, None))
 
 
-def test_random_churn_fully_collected():
+import pytest
+
+
+@pytest.mark.parametrize("backend", ["array", "decremental"])
+def test_random_churn_fully_collected(backend):
+    """Unsound GC kills live actors; incomplete GC times out.  The
+    decremental variant must detect every released subgraph (incl.
+    cycles) by regional repair, never by luck of a full re-trace."""
     shared = Shared()
-    kit = ActorTestKit(CONFIG)
+    kit = ActorTestKit(dict(CONFIG, **{"uigc.crgc.shadow-graph": backend}))
     try:
         def make_root(timers):
             def setup(ctx):
@@ -160,3 +167,4 @@ def test_random_churn_fully_collected():
         )
     finally:
         kit.shutdown()
+

@@ -155,7 +155,10 @@ class Sim:
             self.array = MeshShadowGraph(self.context, self.system.address)
         else:
             self.array = ArrayShadowGraph(
-                self.context, self.system.address, use_device=(backend == "device")
+                self.context,
+                self.system.address,
+                use_device=(backend in ("device", "decremental")),
+                decremental=(backend == "decremental"),
             )
         root_cell = FakeCell(self.system)
         self.root = SimActor(self, root_cell, None, self.context)
@@ -253,7 +256,9 @@ class Sim:
 from conftest import NATIVE_AVAILABLE, NATIVE_BACKEND
 
 
-@pytest.mark.parametrize("backend", ["array", "device", "mesh", NATIVE_BACKEND])
+@pytest.mark.parametrize(
+    "backend", ["array", "device", "mesh", "decremental", NATIVE_BACKEND]
+)
 @pytest.mark.parametrize("seed", [7, 42, 20260729])
 def test_random_protocol_parity(seed, backend):
     sim = Sim(seed, backend=backend)

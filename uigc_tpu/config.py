@@ -42,6 +42,9 @@ DEFAULTS: Dict[str, Any] = {
     #   "mesh"   - fold/trace state sharded across a jax device mesh
     #              (engines/crgc/mesh.py); per-wake deltas stream to the
     #              devices, the trace all_gathers marks over ICI
+    #   "decremental" - device trace that re-derives only the churn's
+    #              affected region per wake from the previous fixpoint
+    #              (ops/pallas_decremental.py: suspect closure + repair)
     "uigc.crgc.shadow-graph": "array",
     # Devices in the mesh backend's mesh; 0 = all visible devices.
     "uigc.crgc.mesh-devices": 0,

@@ -51,11 +51,21 @@ class ArrayShadowGraph:
         context: CrgcContext,
         local_address: Optional[str] = None,
         use_device: bool = False,
+        decremental: bool = False,
         initial_capacity: int = 1024,
     ):
         self.context = context
         self.local_address = local_address
         self.use_device = use_device
+        #: per-wake closure+repair detection relative to the previous
+        #: fixpoint (ops/pallas_decremental.py) instead of a full
+        #: re-trace from seeds; works in interpret mode too, so it is
+        #: not gated on the platform check.
+        assert not decremental or use_device, (
+            "decremental detection runs on the device trace path"
+        )
+        self.decremental = decremental
+        self._dec = None
         self.total_actors_seen = 0
 
         cap = max(16, initial_capacity)
@@ -118,6 +128,7 @@ class ArrayShadowGraph:
         # Pallas layout must be rebuilt.
         self._pair_log = None
         self._inc = None
+        self._dec = None
 
     def _grow_edges(self) -> None:
         old = self.edge_capacity
@@ -536,6 +547,8 @@ class ArrayShadowGraph:
     def compute_marks(self) -> np.ndarray:
         if self.use_device:
             with events.recorder.timed(events.DEVICE_TRACE):
+                if self.decremental:
+                    return self._compute_marks_decremental()
                 if self._on_tpu():
                     return self._compute_marks_pallas()
                 return trace_ops.trace_marks_jax(
@@ -574,30 +587,52 @@ class ArrayShadowGraph:
         when accumulated churn crosses the layout's repack threshold."""
         from ...ops import pallas_incremental
 
-        inc = self._inc
-        if inc is None or self._pair_log is None:
-            if inc is None or inc.n != self.capacity:
-                # Only a geometry change needs a fresh object; a plain
-                # log overflow keeps the layout (and its stats/caches)
-                # and just repacks it.
-                inc = self._inc = pallas_incremental.IncrementalPallasLayout(
-                    self.capacity
-                )
-            inc.rebuild(
+        self._inc = self._sync_layout(
+            self._inc,
+            lambda: pallas_incremental.IncrementalPallasLayout(self.capacity),
+            lambda l: l.needs_repack,
+        )
+        return self._inc.trace(self.flags, self.recv_count)
+
+    def _sync_layout(self, obj, make, needs_repack) -> object:
+        """The pair-log consumption state machine shared by the Pallas
+        and decremental paths: (re)build on a missing object, geometry
+        change, or log overflow (``_pair_log is None``); otherwise fold
+        the log and repack when accumulated churn crosses the layout's
+        threshold.  Returns the up-to-date object."""
+        if obj is None or self._pair_log is None:
+            if obj is None or obj.n != self.capacity:
+                obj = make()
+            obj.rebuild(
                 self.edge_src, self.edge_dst, self.edge_weight, self.supervisor
             )
             self._pair_log = []
         elif self._pair_log:
-            inc.apply_log(self._pair_log)
+            obj.apply_log(self._pair_log)
             self._pair_log.clear()
-            if inc.needs_repack:
-                inc.rebuild(
+            if needs_repack(obj):
+                obj.rebuild(
                     self.edge_src,
                     self.edge_dst,
                     self.edge_weight,
                     self.supervisor,
                 )
-        return inc.trace(self.flags, self.recv_count)
+        return obj
+
+    def _compute_marks_decremental(self) -> np.ndarray:
+        """Per-wake detection through the decremental tracer: the wake
+        cost is proportional to the churn's affected region, not the
+        graph (ops/pallas_decremental.py; the steady-state analogue of
+        the reference's 50ms incremental collect, LocalGC.scala:144-186,
+        at scales where a full re-trace cannot meet the cadence)."""
+        from ...ops import pallas_decremental
+
+        self._dec = self._sync_layout(
+            self._dec,
+            lambda: pallas_decremental.DecrementalTracer(self.capacity),
+            lambda d: d.layout.needs_repack,
+        )
+        return self._dec.marks(self.flags, self.recv_count)
 
     def trace(self, should_kill: bool) -> int:
         with events.recorder.timed(events.TRACING) as ev:

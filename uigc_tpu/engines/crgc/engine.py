@@ -77,13 +77,14 @@ class CRGC(Engine):
             from .shadow import ShadowGraph
 
             return ShadowGraph(self.crgc_context, self.system.address)
-        elif self.shadow_graph_impl in ("array", "device"):
+        elif self.shadow_graph_impl in ("array", "device", "decremental"):
             from .arrays import ArrayShadowGraph
 
             return ArrayShadowGraph(
                 self.crgc_context,
                 self.system.address,
-                use_device=(self.shadow_graph_impl == "device"),
+                use_device=(self.shadow_graph_impl in ("device", "decremental")),
+                decremental=(self.shadow_graph_impl == "decremental"),
             )
         elif self.shadow_graph_impl == "native":
             from ...native import NativeShadowGraph
