@@ -72,7 +72,10 @@ from conftest import NATIVE_BACKEND
 
 @pytest.mark.parametrize(
     "backend",
-    ["oracle", "array", "device", "mesh", "decremental", NATIVE_BACKEND],
+    [
+        "oracle", "array", "device", "mesh", "decremental",
+        "mesh-decremental", NATIVE_BACKEND,
+    ],
 )
 def test_cycle_collection_all_backends(backend):
     kit = ActorTestKit(

@@ -131,3 +131,153 @@ def test_sharded_pallas_matches_host(seed):
         )
     )[:n]
     assert np.array_equal(mark, mark_host)
+
+
+def test_sharded_decremental_wakes():
+    """The closure+repair wake on the virtual mesh: flag churn (halts,
+    de-seeding, frees, slots coming alive) and bucket-tier edge churn
+    across wakes, each diffed against the from-scratch host oracle.  A
+    zeroed previous state is the cold start."""
+    import jax
+
+    from uigc_tpu.ops import pallas_incremental as pinc
+    from uigc_tpu.parallel import (
+        make_sharded_decremental_wake,
+        pack_shard_layouts,
+    )
+
+    n_devices = min(8, len(jax.devices()))
+    s_rows = 8
+    rng = np.random.default_rng(5)
+    graph = powerlaw_actor_graph(20_000, seed=5, garbage_fraction=0.4)
+    n = graph["flags"].shape[0]
+
+    super_sz = s_rows * 128
+    chunk = n_devices * super_sz
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    flags = np.zeros(n_pad, np.uint8)
+    flags[:n] = graph["flags"]
+    recv = np.zeros(n_pad, np.int64)
+    recv[:n] = graph["recv_count"]
+
+    psrc, pdst, kinds = pinc.IncrementalPallasLayout.pairs_from_graph(
+        graph["edge_src"], graph["edge_dst"], graph["edge_weight"],
+        graph["supervisor"],
+    )
+    stacked, meta, slot_vals = pack_shard_layouts(
+        psrc, pdst, n_pad, n_devices, s_rows=s_rows
+    )
+    shard_size = meta["shard_size"]
+    m = 64  # bucket columns per shard
+    bsrc = np.full((n_devices, m), n_pad, np.int32)
+    bdst = np.zeros((n_devices, m), np.int32)
+    bcount = np.zeros(n_devices, np.int64)
+
+    wake = make_sharded_decremental_wake(
+        mesh=build_mesh(n_devices),
+        n_pad=n_pad,
+        shard_size=shard_size,
+        n_blocks=meta["n_blocks"],
+        r_rows=meta["r_rows"],
+        s_rows=s_rows,
+        bucket_m=m,
+        sub=meta["sub"],
+        group=meta["group"],
+    )
+
+    n_words = n_pad // 32
+    zeros_w = np.zeros(n_words, np.int32)
+    state = [zeros_w] * 5  # mark, seed, halted, iu, active
+    live_pairs = list(zip(psrc.tolist(), pdst.tolist()))
+    bucket_pairs = []
+
+    def oracle():
+        allp = live_pairs + bucket_pairs
+        s = np.array([p[0] for p in allp], np.int32)
+        d = np.array([p[1] for p in allp], np.int32)
+        return trace_ops.trace_marks_np(
+            flags[:n], recv[:n], np.full(n, -1, np.int32),
+            s, d, np.ones(len(allp), np.int64),
+        )
+
+    def words_of(ids):
+        w = np.zeros(n_words, np.uint32)
+        ids = np.asarray(sorted(set(ids)), np.int64)
+        if ids.size:
+            np.bitwise_or.at(
+                w, ids >> 5, np.uint32(1) << (ids & 31).astype(np.uint32)
+            )
+        return w.view(np.int32)
+
+    def run_wake(del_ids, fresh_ids):
+        nonlocal state
+        out = wake(
+            flags, recv, words_of(del_ids), words_of(fresh_ids),
+            *state,
+            stacked["bmeta1"], stacked["bmeta2"],
+            stacked["row_pos"], stacked["emeta"],
+            bsrc, bdst,
+        )
+        mark = np.asarray(out[0])[:n]
+        state = [np.asarray(o) for o in out[1:]]
+        return mark
+
+    # cold start = full derivation
+    assert np.array_equal(run_wake([], []), oracle())
+
+    for wk in range(5):
+        del_ids, fresh_ids = [], []
+        # flag churn
+        for _ in range(20):
+            i = int(rng.integers(0, n))
+            r = rng.random()
+            if r < 0.3:
+                flags[i] |= trace_ops.FLAG_HALTED
+            elif r < 0.5:
+                flags[i] ^= trace_ops.FLAG_BUSY
+            elif r < 0.7:
+                recv[i] = 0 if recv[i] else 2
+            elif r < 0.85:
+                flags[i] = 0  # freed
+            else:
+                flags[i] = trace_ops.FLAG_IN_USE | trace_ops.FLAG_INTERNED
+        # bucket-tier inserts (fresh pairs)
+        for _ in range(10):
+            s_, d_ = int(rng.integers(0, n)), int(rng.integers(0, n))
+            sh = d_ // shard_size
+            c = int(bcount[sh])
+            if c >= m or (s_, d_) in bucket_pairs:
+                continue
+            bsrc[sh, c] = s_
+            bdst[sh, c] = d_ - sh * shard_size
+            bcount[sh] = c + 1
+            bucket_pairs.append((s_, d_))
+            fresh_ids.append(d_)
+        # base-layout deletions via in-place slot masking
+        for _ in range(10):
+            j = int(rng.integers(0, len(live_pairs)))
+            if live_pairs[j] is None:
+                continue
+            s_, d_ = live_pairs[j]
+            live_pairs[j] = None
+            sv = int(slot_vals[j])
+            sh, ri, col = sv >> 40, (sv >> 8) & 0xFFFFFFFF, sv & 0xFF
+            from uigc_tpu.ops import pallas_trace as pt
+
+            stacked["row_pos"][sh, ri, col] = pt._PAD_ROW
+            stacked["emeta"][sh, ri, col] = 0
+            del_ids.append(d_)
+        # live_pairs keeps None holes so slot_vals indices stay stable
+        live_pairs_c = [p for p in live_pairs if p is not None]
+
+        got = run_wake(del_ids, fresh_ids)
+        allp = live_pairs_c + bucket_pairs
+        s = np.array([p[0] for p in allp], np.int32)
+        d = np.array([p[1] for p in allp], np.int32)
+        expected = trace_ops.trace_marks_np(
+            flags[:n], recv[:n], np.full(n, -1, np.int32),
+            s, d, np.ones(len(allp), np.int64),
+        )
+        assert np.array_equal(got, expected), (
+            f"wake {wk}: {int((got != expected).sum())} mismatches"
+        )

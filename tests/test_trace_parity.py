@@ -149,10 +149,14 @@ class Sim:
             from uigc_tpu.native import NativeShadowGraph
 
             self.array = NativeShadowGraph(self.context, self.system.address)
-        elif backend == "mesh":
+        elif backend in ("mesh", "mesh-decremental"):
             from uigc_tpu.engines.crgc.mesh import MeshShadowGraph
 
-            self.array = MeshShadowGraph(self.context, self.system.address)
+            self.array = MeshShadowGraph(
+                self.context,
+                self.system.address,
+                decremental=(backend == "mesh-decremental"),
+            )
         else:
             self.array = ArrayShadowGraph(
                 self.context,
@@ -257,7 +261,9 @@ from conftest import NATIVE_AVAILABLE, NATIVE_BACKEND
 
 
 @pytest.mark.parametrize(
-    "backend", ["array", "device", "mesh", "decremental", NATIVE_BACKEND]
+    "backend",
+    ["array", "device", "mesh", "decremental", "mesh-decremental",
+     NATIVE_BACKEND],
 )
 @pytest.mark.parametrize("seed", [7, 42, 20260729])
 def test_random_protocol_parity(seed, backend):

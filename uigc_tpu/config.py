@@ -45,6 +45,8 @@ DEFAULTS: Dict[str, Any] = {
     #   "decremental" - device trace that re-derives only the churn's
     #              affected region per wake from the previous fixpoint
     #              (ops/pallas_decremental.py: suspect closure + repair)
+    #   "mesh-decremental" - the mesh backend with the decremental wake
+    #              per shard (one word all_gather per sweep)
     "uigc.crgc.shadow-graph": "array",
     # Devices in the mesh backend's mesh; 0 = all visible devices.
     "uigc.crgc.mesh-devices": 0,

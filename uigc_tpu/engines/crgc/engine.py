@@ -90,13 +90,14 @@ class CRGC(Engine):
             from ...native import NativeShadowGraph
 
             return NativeShadowGraph(self.crgc_context, self.system.address)
-        elif self.shadow_graph_impl == "mesh":
+        elif self.shadow_graph_impl in ("mesh", "mesh-decremental"):
             from .mesh import MeshShadowGraph
 
             return MeshShadowGraph(
                 self.crgc_context,
                 self.system.address,
                 n_devices=self.system.config.get_int("uigc.crgc.mesh-devices"),
+                decremental=(self.shadow_graph_impl == "mesh-decremental"),
             )
         raise ValueError(f"bad shadow-graph impl {self.shadow_graph_impl!r}")
 

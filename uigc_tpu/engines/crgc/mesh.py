@@ -61,6 +61,7 @@ class MeshShadowGraph(ArrayShadowGraph):
         local_address: Optional[str] = None,
         n_devices: int = 0,
         initial_capacity: int = 1024,
+        decremental: bool = False,
     ):
         super().__init__(
             context,
@@ -112,6 +113,13 @@ class MeshShadowGraph(ArrayShadowGraph):
         #: packed (src, dst, kind) key -> packed (shard << 32 | column)
         self._pb_slot = PackedSlotMap()
         self.stats = {"rebuilds": 0, "wakes": 0, "anomalies": 0}
+
+        #: per-wake closure+repair detection on the mesh
+        #: (parallel/sharded_trace.make_sharded_decremental_wake)
+        self.decremental = decremental
+        self._wake_state: Optional[list] = None  # mark/seed/halt/iu/active
+        self._pending_del_dst: set = set()
+        self._pending_fresh_dst: set = set()
 
         self._jit_cache: Dict[str, object] = {}
         self._trace_cache: Dict[tuple, object] = {}
@@ -193,6 +201,9 @@ class MeshShadowGraph(ArrayShadowGraph):
 
         self._pair_log = []
         self._node_log = set()
+        self._wake_state = None
+        self._pending_del_dst.clear()
+        self._pending_fresh_dst.clear()
         self._dev_ready = True
 
     # ------------------------------------------------------------- #
@@ -210,6 +221,19 @@ class MeshShadowGraph(ArrayShadowGraph):
         argument and anomaly accounting live in slotmap.fold_log): slot
         lookups are one vectorized binary search per batch."""
         removes, cond_removes, inserts = fold_log(self._pair_log)
+        if self.decremental:
+            # Suspect bookkeeping for the decremental wake: removal
+            # destinations must re-derive; insert destinations must see
+            # their new pair once.  Over-approximation is sound.
+            rem = removes + cond_removes
+            if rem:
+                _, d = unpack_keys(np.fromiter(rem, np.int64, len(rem)))
+                self._pending_del_dst.update(d.tolist())
+            if inserts:
+                _, d = unpack_keys(
+                    np.fromiter(inserts, np.int64, len(inserts))
+                )
+                self._pending_fresh_dst.update(d.tolist())
         writes: Dict[Tuple[int, int], Tuple[int, int]] = {}
         stacked = self._stacked
 
@@ -391,11 +415,28 @@ class MeshShadowGraph(ArrayShadowGraph):
     # Trace
     # ------------------------------------------------------------- #
 
+    def _word_array(self, id_set: set):
+        """Scatter an id set into the node-word array, sharded like the
+        node arrays (word w of shard d covers nodes d*shard + 32w..)."""
+        import jax
+
+        n_words = self._n_pad // 32
+        words = np.zeros(n_words, dtype=np.uint32)
+        if id_set:
+            ids = np.fromiter(id_set, np.int64, len(id_set))
+            np.bitwise_or.at(
+                words, ids >> 5, np.uint32(1) << (ids & 31).astype(np.uint32)
+            )
+        nodes_s, _, _ = self._sharding()
+        return jax.device_put(words.view(np.int32), nodes_s)
+
     def compute_marks(self) -> np.ndarray:
         with events.recorder.timed(events.DEVICE_TRACE):
             self._sync_device()
             self.stats["wakes"] += 1
             meta = self._layout_meta
+            if self.decremental:
+                return self._compute_marks_decremental(meta)
             key = (self._n_pad, meta["n_blocks"], self._bucket_m)
             traced = self._trace_cache.get(key)
             if traced is None:
@@ -422,3 +463,66 @@ class MeshShadowGraph(ArrayShadowGraph):
                 self._dev_pdst,
             )
             return np.asarray(mark)[: self.capacity]
+
+    def _compute_marks_decremental(self, meta) -> np.ndarray:
+        """The closure+repair wake on the mesh: regional re-derivation
+        per shard, one word all_gather per sweep.  A zeroed previous
+        state (cold start, post-rebuild) is the full derivation."""
+        import jax
+
+        key = ("dec", self._n_pad, meta["n_blocks"], self._bucket_m)
+        wake = self._trace_cache.get(key)
+        if wake is None:
+            wake = sharded_trace.make_sharded_decremental_wake(
+                self.mesh,
+                self._n_pad,
+                self._shard_size,
+                meta["n_blocks"],
+                meta["r_rows"],
+                self.s_rows,
+                self._bucket_m,
+                sub=meta["sub"],
+                group=meta["group"],
+            )
+            self._trace_cache[key] = wake
+        if self._wake_state is None:
+            nodes_s, _, _ = self._sharding()
+            z = jax.device_put(
+                np.zeros(self._n_pad // 32, np.int32), nodes_s
+            )
+            self._wake_state = [z] * 5
+        del_w = self._word_array(self._pending_del_dst)
+        fresh_w = self._word_array(self._pending_fresh_dst)
+        out = wake(
+            self._dev_flags,
+            self._dev_recv,
+            del_w,
+            fresh_w,
+            *self._wake_state,
+            self._dev_stacked["bmeta1"],
+            self._dev_stacked["bmeta2"],
+            self._dev_stacked["row_pos"],
+            self._dev_stacked["emeta"],
+            self._dev_psrc,
+            self._dev_pdst,
+        )
+        # The mark readback is the first point a poisoned async result
+        # surfaces; commit state + drain suspects only after it, and
+        # invalidate on failure so the next wake re-derives from zero
+        # state instead of feeding poisoned arrays forever.
+        try:
+            mark = np.asarray(out[0])[: self.capacity]
+        except Exception:
+            self.invalidate_wake_state()
+            raise
+        self._wake_state = list(out[1:])
+        self._pending_del_dst.clear()
+        self._pending_fresh_dst.clear()
+        return mark
+
+    def invalidate_wake_state(self) -> None:
+        """Drop the previous-fixpoint state (failed/poisoned wake): the
+        next wake is a full derivation and pending suspects are moot."""
+        self._wake_state = None
+        self._pending_del_dst.clear()
+        self._pending_fresh_dst.clear()

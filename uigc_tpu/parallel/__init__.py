@@ -1,5 +1,6 @@
 from .sharded_trace import (
     build_mesh,
+    make_sharded_decremental_wake,
     make_sharded_fold,
     make_sharded_pallas_trace,
     make_sharded_trace,
@@ -9,6 +10,7 @@ from .sharded_trace import (
 
 __all__ = [
     "build_mesh",
+    "make_sharded_decremental_wake",
     "make_sharded_fold",
     "make_sharded_pallas_trace",
     "make_sharded_trace",

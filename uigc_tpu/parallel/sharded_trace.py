@@ -317,7 +317,6 @@ def make_sharded_pallas_trace(
     )
     group_rows = pt.ROWS * group
     n_chunks = r_rows // group_rows
-    shard_words = shard_size // pt.WORD_BITS
     words_pad = r_rows * pt.LANE
 
     def local_trace(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
@@ -548,3 +547,245 @@ def make_sharded_fold(mesh, axis: str = "gc", donate: bool = False):
         return f2.reshape(-1), r2.reshape(-1)
 
     return fold
+
+
+def make_sharded_decremental_wake(
+    mesh,
+    n_pad: int,
+    shard_size: int,
+    n_blocks: int,
+    r_rows: int,
+    s_rows: int,
+    bucket_m: int,
+    interpret: bool = None,
+    axis: str = "gc",
+    sub: int = None,
+    group: int = None,
+):
+    """The decremental wake (suspect closure + destination-gated repair,
+    ops/pallas_decremental.py) on the sharded data plane: per-wake cost
+    proportional to the churn's affected region *per shard*, with one
+    packed-word all_gather over ICI per sweep.
+
+    fn(flags, recv, del_w, fresh_w, prev_mark_w, prev_seed_w,
+       prev_halted_w, prev_iu_w, prev_active_w,
+       bmeta1, bmeta2, row_pos, emeta, bsrc, bdst)
+      -> (mark (bool[n_pad]), mark_w, seed_w, halted_w, iu_w, active_w)
+
+    flags/recv sharded by node range; every *_w operand is the flat word
+    array (n_pad/32 ints) sharded by word range (same node partition);
+    layout operands as in make_sharded_pallas_trace.  A zeroed previous
+    state degenerates to the full derivation from seeds, so cold start
+    and post-rebuild wakes need no separate path.
+    """
+    jax, jnp = _jax()
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import pallas_trace as pt
+    from ..ops import trace as F
+
+    if interpret is None:
+        interpret = pt.default_interpret()
+    if sub is None or group is None:
+        d_sub, d_group = pt.default_geometry(interpret)
+        sub = d_sub if sub is None else sub
+        group = d_group if group is None else group
+    super_sz = s_rows * pt.LANE
+    n_super_shard = shard_size // super_sz
+    propagate = pt.build_propagate(
+        n_blocks, n_super_shard, r_rows, s_rows, interpret,
+        sub=sub, group=group, dst_gate=True,
+    )
+    group_rows = pt.ROWS * group
+    n_chunks = r_rows // group_rows
+    words_pad = r_rows * pt.LANE
+    t_local = shard_size // pt.LANE
+    sup_words = s_rows * (pt.LANE // pt.WORD_BITS)
+
+    def local_wake(flags, recv, del_w, fresh_w, p_mark, p_seed, p_halt,
+                   p_iu, p_active, bmeta1, bmeta2, row_pos, emeta,
+                   bsrc, bdst):
+        flags = flags.reshape(-1)
+        recv = recv.reshape(-1)
+        del_w = del_w.reshape(-1)
+        fresh_w = fresh_w.reshape(-1)
+        p_mark = p_mark.reshape(-1)
+        p_seed = p_seed.reshape(-1)
+        p_halt = p_halt.reshape(-1)
+        p_iu = p_iu.reshape(-1)
+        p_active = p_active.reshape(-1)
+        bmeta1 = bmeta1.reshape(-1)
+        bmeta2 = bmeta2.reshape(-1)
+        row_pos = row_pos.reshape(-1, pt.LANE)
+        emeta = emeta.reshape(-1, pt.LANE)
+        bsrc = bsrc.reshape(-1)
+        bdst = bdst.reshape(-1)
+
+        in_use = (flags & F.FLAG_IN_USE) != 0
+        halted = (flags & F.FLAG_HALTED) != 0
+        seed = (
+            ((flags & F.FLAG_ROOT) != 0)
+            | ((flags & F.FLAG_BUSY) != 0)
+            | (recv != 0)
+            | ((flags & F.FLAG_INTERNED) == 0)
+        )
+        shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
+
+        def pack_words(local_bool):
+            return (
+                local_bool.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
+                << shifts[None, :]
+            ).sum(axis=1, dtype=jnp.int32)
+
+        def gather_table(local_words):
+            w_all = jax.lax.all_gather(local_words, axis).reshape(-1)
+            w_all = jnp.concatenate(
+                [w_all, jnp.zeros((words_pad - w_all.shape[0],), jnp.int32)]
+            )
+            return w_all.reshape(r_rows, pt.LANE)
+
+        def dirty_chunks(table, table_prev):
+            return pt.dirty_group_lists(
+                table, table_prev, n_chunks, group_rows, jnp
+            )
+
+        def src_bits(table, src):
+            word = src >> 5
+            w = table[word >> 7, word & 127]
+            return (((w >> (src & 31)) & 1) > 0) & (src < n_pad)
+
+        def sweep_hits(table, d, l, gate):
+            """One propagation sweep into this shard: packed blocks
+            (dst-gated) + the insert-bucket scatter-max tier."""
+            contrib = propagate(
+                d, l, gate, bmeta1, bmeta2, table, row_pos, emeta
+            )
+            src_active = src_bits(table, bsrc)
+            prop = (
+                jnp.zeros((shard_size + 1,), jnp.int32)
+                .at[bdst]
+                .max(src_active.astype(jnp.int32))
+            )
+            return (contrib.reshape(t_local, pt.LANE) > 0) | (
+                prop[:shard_size].reshape(t_local, pt.LANE) > 0
+            )
+
+        def pack2d(hits2d):
+            return pt.pack_hits_words(hits2d, jnp)
+
+        iu_w = pack_words(in_use)
+        halted_w = pack_words(halted)
+        nh_w = pack_words(~halted)
+        seed_w = pack_words(in_use & (~halted) & seed)
+        zero_gate = jnp.zeros((n_super_shard,), jnp.int32)
+
+        def per_super(words):
+            return (
+                words.reshape(n_super_shard, sup_words)
+                .any(axis=1)
+                .astype(jnp.int32)
+            )
+
+        # --- 1. suspect seeds (shard-local) ------------------------- #
+        s_w = (
+            (~iu_w)
+            | (halted_w & ~p_halt)
+            | (p_seed & ~seed_w)
+            | del_w
+        ) & p_mark
+
+        # --- 2. closure: marks that depended on a suspect ----------- #
+        def c_cond(carry):
+            return carry[-1]
+
+        def c_body(carry):
+            closure_w, table, d, l, _ = carry
+            hits2d = sweep_hits(table, d, l, zero_gate)
+            new_closure = closure_w | (pack2d(hits2d) & p_mark)
+            new_table = gather_table(new_closure)
+            d2, l2, changed = dirty_chunks(new_table, table)
+            return new_closure, new_table, d2, l2, changed
+
+        c_table0 = gather_table(s_w)
+        cd0, cl0, cch0 = dirty_chunks(c_table0, jnp.zeros_like(c_table0))
+        closure_w, _, _, _, _ = jax.lax.while_loop(
+            c_cond, c_body, (s_w, c_table0, cd0, cl0, cch0)
+        )
+
+        suspect_g = (
+            per_super(closure_w)
+            | per_super(fresh_w)
+            | per_super(iu_w & ~p_iu)
+        )
+
+        # --- 3. repair fixpoint ------------------------------------- #
+        mark_w0 = (p_mark & ~closure_w) | seed_w
+        active_w0 = mark_w0 & nh_w
+        table0 = gather_table(active_w0)
+        prev_table = gather_table(p_active)
+        rd0, rl0, rch0 = dirty_chunks(table0, prev_table)
+        # Replicated run-gate decision: every shard must agree on the
+        # first (gated) sweep or the collectives deadlock.
+        any_gate = jax.lax.psum(suspect_g.sum(), axis) > 0
+        run0 = rch0 | any_gate
+
+        def r_cond(carry):
+            return carry[-1]
+
+        def r_body(carry):
+            mark_w, table, d, l, use_gate, _ = carry
+            gate = jnp.where(use_gate, suspect_g, zero_gate)
+            hits2d = sweep_hits(table, d, l, gate)
+            new_mark = mark_w | (pack2d(hits2d) & iu_w)
+            new_table = gather_table(new_mark & nh_w)
+            d2, l2, changed = dirty_chunks(new_table, table)
+            return new_mark, new_table, d2, l2, jnp.array(False), changed
+
+        mark_w, _, _, _, _, _ = jax.lax.while_loop(
+            r_cond,
+            r_body,
+            (mark_w0, table0, rd0, rl0, jnp.array(True), run0),
+        )
+        active_w = mark_w & nh_w
+
+        bits = (mark_w[:, None] >> shifts[None, :]) & 1
+        mark = bits.reshape(-1) > 0
+        one = lambda x: x.reshape(1, -1)
+        return (
+            one(mark), one(mark_w), one(seed_w), one(halted_w),
+            one(iu_w), one(active_w),
+        )
+
+    spec_nodes = P(axis)
+    spec_dev = P(axis, None)
+    spec_dev3 = P(axis, None, None)
+
+    in_specs = (
+        spec_nodes, spec_nodes,  # flags, recv
+        spec_nodes, spec_nodes,  # del_w, fresh_w (word-sharded)
+        spec_nodes, spec_nodes, spec_nodes, spec_nodes, spec_nodes,  # prev
+        spec_dev, spec_dev, spec_dev3, spec_dev3,  # layout
+        spec_dev, spec_dev,  # buckets
+    )
+    out_specs = (spec_dev,) * 6
+    try:
+        fn = shard_map(
+            local_wake, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        fn = shard_map(
+            local_wake, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+    @jax.jit
+    def wake(*args):
+        outs = fn(*args)
+        return tuple(o.reshape(-1) for o in outs)
+
+    return wake
