@@ -125,6 +125,18 @@ def main() -> None:
         ),
     )
     parser.add_argument(
+        "--sub",
+        type=int,
+        default=None,
+        help="kernel walk geometry override: slot sub-blocks per grid step",
+    )
+    parser.add_argument(
+        "--group",
+        type=int,
+        default=None,
+        help="kernel walk geometry override: 8-row chunks per walk iteration",
+    )
+    parser.add_argument(
         "--config",
         choices=["powerlaw", "churn", "mac", "rings", "cluster"],
         default="powerlaw",
@@ -215,7 +227,9 @@ def main() -> None:
         if impl == "pallas" and args.layout == "incremental":
             from uigc_tpu.ops import pallas_incremental
 
-            layout = pallas_incremental.IncrementalPallasLayout(n)
+            layout = pallas_incremental.IncrementalPallasLayout(
+                n, sub=args.sub, group=args.group
+            )
             layout.rebuild(
                 graph["edge_src"],
                 graph["edge_dst"],
@@ -236,6 +250,8 @@ def main() -> None:
                 graph["edge_weight"],
                 graph["supervisor"],
                 n,
+                sub=args.sub,
+                group=args.group,
             )
             fn = pallas_trace.get_trace_fn(prep)
             host_args = (

@@ -417,17 +417,25 @@ class MeshShadowGraph(ArrayShadowGraph):
 
     def _word_array(self, id_set: set):
         """Scatter an id set into the node-word array, sharded like the
-        node arrays (word w of shard d covers nodes d*shard + 32w..)."""
+        node arrays (word w of shard d covers nodes d*shard + 32w..).
+        Empty sets (the quiet steady state) reuse one cached zero array
+        instead of allocating + transferring per wake."""
         import jax
 
-        n_words = self._n_pad // 32
-        words = np.zeros(n_words, dtype=np.uint32)
-        if id_set:
-            ids = np.fromiter(id_set, np.int64, len(id_set))
-            np.bitwise_or.at(
-                words, ids >> 5, np.uint32(1) << (ids & 31).astype(np.uint32)
-            )
         nodes_s, _, _ = self._sharding()
+        n_words = self._n_pad // 32
+        if not id_set:
+            z = getattr(self, "_zero_words", None)
+            if z is None or z.shape[0] != n_words:
+                z = self._zero_words = jax.device_put(
+                    np.zeros(n_words, np.int32), nodes_s
+                )
+            return z
+        words = np.zeros(n_words, dtype=np.uint32)
+        ids = np.fromiter(id_set, np.int64, len(id_set))
+        np.bitwise_or.at(
+            words, ids >> 5, np.uint32(1) << (ids & 31).astype(np.uint32)
+        )
         return jax.device_put(words.view(np.int32), nodes_s)
 
     def compute_marks(self) -> np.ndarray:

@@ -107,6 +107,98 @@ def shard_graph(
     }
 
 
+def _shard_map_compat(local_fn, mesh, in_specs, out_specs):
+    """shard_map with the check_vma/check_rep compat fallback (pallas_call
+    does not propagate the varying-mesh-axes annotation)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(
+            local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:
+        return shard_map(
+            local_fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+
+def _seed_masks(flags, recv, jnp):
+    """(in_use, halted, seed) bool vectors from the node features — the
+    one seed definition every trace variant shares (reference semantics:
+    ShadowGraph.java:205-220)."""
+    from ..ops import trace as F
+
+    in_use = (flags & F.FLAG_IN_USE) != 0
+    halted = (flags & F.FLAG_HALTED) != 0
+    seed = (
+        ((flags & F.FLAG_ROOT) != 0)
+        | ((flags & F.FLAG_BUSY) != 0)
+        | (recv != 0)
+        | ((flags & F.FLAG_INTERNED) == 0)
+    )
+    return in_use, halted, seed
+
+
+def make_local_shard_ops(axis, words_pad, r_rows, n_pad, shard_size, jnp):
+    """The per-shard word-space primitives shared by the mesh trace and
+    the mesh decremental wake: local bool pack, global-table all_gather,
+    and the packed-table source-bit gather.  One definition keeps the two
+    fixpoints propagating identically per sweep."""
+    import jax
+
+    from ..ops import pallas_trace as pt
+
+    shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
+
+    def pack_words(local_bool):
+        return (
+            local_bool.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
+            << shifts[None, :]
+        ).sum(axis=1, dtype=jnp.int32)
+
+    def gather_table(local_words):
+        w_all = jax.lax.all_gather(local_words, axis).reshape(-1)
+        w_all = jnp.concatenate(
+            [w_all, jnp.zeros((words_pad - w_all.shape[0],), jnp.int32)]
+        )
+        return w_all.reshape(r_rows, pt.LANE)
+
+    def src_bits(table, src):
+        """Global source active bits from the packed table; bucket
+        padding uses src = n_pad (the sink), masked explicitly."""
+        word = src >> 5
+        w = table[word >> 7, word & 127]
+        return (((w >> (src & 31)) & 1) > 0) & (src < n_pad)
+
+    def make_sweep(propagate, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
+        """One propagation sweep into this shard: dst-gated packed
+        blocks + the insert-bucket scatter-max tier.  A zero gate makes
+        the gated kernel behave exactly like the plain one."""
+        t_local = shard_size // pt.LANE
+
+        def sweep_hits(table, d, l, gate):
+            contrib = propagate(
+                d, l, gate, bmeta1, bmeta2, table, row_pos, emeta
+            )
+            src_active = src_bits(table, bsrc)
+            prop = (
+                jnp.zeros((shard_size + 1,), jnp.int32)
+                .at[bdst]
+                .max(src_active.astype(jnp.int32))
+            )
+            return (contrib.reshape(t_local, pt.LANE) > 0) | (
+                prop[:shard_size].reshape(t_local, pt.LANE) > 0
+            )
+
+        return sweep_hits
+
+    return pack_words, gather_table, src_bits, make_sweep
+
+
 def make_sharded_trace(mesh, axis: str = "gc"):
     """Build the jitted multi-device trace step over ``mesh``.
 
@@ -294,14 +386,9 @@ def make_sharded_pallas_trace(
     leading device axis.
     """
     jax, jnp = _jax()
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..ops import pallas_trace as pt
-    from ..ops import trace as F
 
     if interpret is None:
         interpret = pt.default_interpret()
@@ -311,9 +398,12 @@ def make_sharded_pallas_trace(
         group = d_group if group is None else group
     super_sz = s_rows * pt.LANE
     n_super_shard = shard_size // super_sz
+    # dst-gated kernel with a constant zero gate == the plain kernel;
+    # using it here keeps ONE kernel build shared with the decremental
+    # wake (which passes a real gate on its repair sweep).
     propagate = pt.build_propagate(
         n_blocks, n_super_shard, r_rows, s_rows, interpret,
-        sub=sub, group=group,
+        sub=sub, group=group, dst_gate=True,
     )
     group_rows = pt.ROWS * group
     n_chunks = r_rows // group_rows
@@ -329,57 +419,21 @@ def make_sharded_pallas_trace(
         bsrc = bsrc.reshape(-1)
         bdst = bdst.reshape(-1)
 
-        in_use = (flags & F.FLAG_IN_USE) != 0
-        halted = (flags & F.FLAG_HALTED) != 0
-        seed = (
-            ((flags & F.FLAG_ROOT) != 0)
-            | ((flags & F.FLAG_BUSY) != 0)
-            | (recv != 0)
-            | ((flags & F.FLAG_INTERNED) == 0)
-        )
+        in_use, halted, seed = _seed_masks(flags, recv, jnp)
         mark0 = in_use & (~halted) & seed
 
-        shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
-        t_local = shard_size // pt.LANE  # contrib rows in this shard
-
-        def pack_words(local_bool):
-            """Pack the (shard_size,) local bool vector into local words
-            (one-time gate/seed packing)."""
-            return (
-                local_bool.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
-                << shifts[None, :]
-            ).sum(axis=1, dtype=jnp.int32)
-
-        def pack2d(hits2d):
-            """Local word-pack of (t_local, LANE) hits (contrib layout);
-            see pallas_trace.pack_hits_words for the layout invariant."""
-            return pt.pack_hits_words(hits2d, jnp)
-
-        def gather_table(local_words):
-            """all_gather every shard's active words over ICI and lay
-            them out as the global packed table."""
-            w_all = jax.lax.all_gather(local_words, axis).reshape(-1)
-            w_all = jnp.concatenate(
-                [w_all, jnp.zeros((words_pad - w_all.shape[0],), jnp.int32)]
-            )
-            return w_all.reshape(r_rows, pt.LANE)
-
-        def unpack(local_words):
-            bits = (local_words[:, None] >> shifts[None, :]) & 1
-            return bits.reshape(-1) > 0
+        pack_words, gather_table, _, make_sweep = make_local_shard_ops(
+            axis, words_pad, r_rows, n_pad, shard_size, jnp
+        )
+        sweep_hits = make_sweep(
+            propagate, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst
+        )
+        zero_gate = jnp.zeros((n_super_shard,), jnp.int32)
 
         def dirty_chunks(table, table_prev):
             return pt.dirty_group_lists(
                 table, table_prev, n_chunks, group_rows, jnp
             )
-
-        def src_bits(table, src):
-            """Gather global source active bits from the packed table.
-            Bucket padding uses src = n_pad (the sink): mask it out
-            explicitly rather than trusting the clamped gather."""
-            word = src >> 5
-            w = table[word >> 7, word & 127]
-            return (((w >> (src & 31)) & 1) > 0) & (src < n_pad)
 
         def cond(carry):
             return carry[-1]
@@ -389,18 +443,8 @@ def make_sharded_pallas_trace(
 
         def body(carry):
             mark_w, table, d, l, _ = carry
-            contrib = propagate(d, l, bmeta1, bmeta2, table, row_pos, emeta)
-            # insert-bucket tier: global src gather, local scatter-max
-            src_active = src_bits(table, bsrc)
-            prop = (
-                jnp.zeros((shard_size + 1,), jnp.int32)
-                .at[bdst]
-                .max(src_active.astype(jnp.int32))
-            )
-            hits2d = (contrib.reshape(t_local, pt.LANE) > 0) | (
-                prop[:shard_size].reshape(t_local, pt.LANE) > 0
-            )
-            new_mark_w = mark_w | (pack2d(hits2d) & iu_w)
+            hits2d = sweep_hits(table, d, l, zero_gate)
+            new_mark_w = mark_w | (pt.pack_hits_words(hits2d, jnp) & iu_w)
             new_table = gather_table(new_mark_w & nh_w)
             d2, l2, changed = dirty_chunks(new_table, table)
             return new_mark_w, new_table, d2, l2, changed
@@ -411,7 +455,9 @@ def make_sharded_pallas_trace(
         mark_w, _, _, _, _ = jax.lax.while_loop(
             cond, body, (mark_w0, table0, d0, l0, changed0)
         )
-        return unpack(mark_w).reshape(1, -1)
+        shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
+        bits = (mark_w[:, None] >> shifts[None, :]) & 1
+        return (bits.reshape(-1) > 0).reshape(1, -1)
 
     spec_nodes = P(axis)
     spec_dev = P(axis, None)
@@ -427,25 +473,7 @@ def make_sharded_pallas_trace(
         spec_dev,
         spec_dev,
     )
-    try:
-        # pallas_call does not propagate the varying-mesh-axes annotation;
-        # disable the check (named check_vma on current jax, check_rep
-        # on older releases).
-        fn = shard_map(
-            local_trace,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=spec_dev,
-            check_vma=False,
-        )
-    except TypeError:
-        fn = shard_map(
-            local_trace,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=spec_dev,
-            check_rep=False,
-        )
+    fn = _shard_map_compat(local_trace, mesh, in_specs, spec_dev)
 
     @jax.jit
     def traced(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
@@ -579,14 +607,9 @@ def make_sharded_decremental_wake(
     and post-rebuild wakes need no separate path.
     """
     jax, jnp = _jax()
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..ops import pallas_trace as pt
-    from ..ops import trace as F
 
     if interpret is None:
         interpret = pt.default_interpret()
@@ -603,7 +626,6 @@ def make_sharded_decremental_wake(
     group_rows = pt.ROWS * group
     n_chunks = r_rows // group_rows
     words_pad = r_rows * pt.LANE
-    t_local = shard_size // pt.LANE
     sup_words = s_rows * (pt.LANE // pt.WORD_BITS)
 
     def local_wake(flags, recv, del_w, fresh_w, p_mark, p_seed, p_halt,
@@ -625,53 +647,17 @@ def make_sharded_decremental_wake(
         bsrc = bsrc.reshape(-1)
         bdst = bdst.reshape(-1)
 
-        in_use = (flags & F.FLAG_IN_USE) != 0
-        halted = (flags & F.FLAG_HALTED) != 0
-        seed = (
-            ((flags & F.FLAG_ROOT) != 0)
-            | ((flags & F.FLAG_BUSY) != 0)
-            | (recv != 0)
-            | ((flags & F.FLAG_INTERNED) == 0)
+        in_use, halted, seed = _seed_masks(flags, recv, jnp)
+        pack_words, gather_table, _, make_sweep = make_local_shard_ops(
+            axis, words_pad, r_rows, n_pad, shard_size, jnp
         )
-        shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
-
-        def pack_words(local_bool):
-            return (
-                local_bool.reshape(-1, pt.WORD_BITS).astype(jnp.int32)
-                << shifts[None, :]
-            ).sum(axis=1, dtype=jnp.int32)
-
-        def gather_table(local_words):
-            w_all = jax.lax.all_gather(local_words, axis).reshape(-1)
-            w_all = jnp.concatenate(
-                [w_all, jnp.zeros((words_pad - w_all.shape[0],), jnp.int32)]
-            )
-            return w_all.reshape(r_rows, pt.LANE)
+        sweep_hits = make_sweep(
+            propagate, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst
+        )
 
         def dirty_chunks(table, table_prev):
             return pt.dirty_group_lists(
                 table, table_prev, n_chunks, group_rows, jnp
-            )
-
-        def src_bits(table, src):
-            word = src >> 5
-            w = table[word >> 7, word & 127]
-            return (((w >> (src & 31)) & 1) > 0) & (src < n_pad)
-
-        def sweep_hits(table, d, l, gate):
-            """One propagation sweep into this shard: packed blocks
-            (dst-gated) + the insert-bucket scatter-max tier."""
-            contrib = propagate(
-                d, l, gate, bmeta1, bmeta2, table, row_pos, emeta
-            )
-            src_active = src_bits(table, bsrc)
-            prop = (
-                jnp.zeros((shard_size + 1,), jnp.int32)
-                .at[bdst]
-                .max(src_active.astype(jnp.int32))
-            )
-            return (contrib.reshape(t_local, pt.LANE) > 0) | (
-                prop[:shard_size].reshape(t_local, pt.LANE) > 0
             )
 
         def pack2d(hits2d):
@@ -752,6 +738,7 @@ def make_sharded_decremental_wake(
         )
         active_w = mark_w & nh_w
 
+        shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
         bits = (mark_w[:, None] >> shifts[None, :]) & 1
         mark = bits.reshape(-1) > 0
         one = lambda x: x.reshape(1, -1)
@@ -772,16 +759,7 @@ def make_sharded_decremental_wake(
         spec_dev, spec_dev,  # buckets
     )
     out_specs = (spec_dev,) * 6
-    try:
-        fn = shard_map(
-            local_wake, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
-    except TypeError:
-        fn = shard_map(
-            local_wake, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
-        )
+    fn = _shard_map_compat(local_wake, mesh, in_specs, out_specs)
 
     @jax.jit
     def wake(*args):
