@@ -1,0 +1,265 @@
+"""Device-only per-wake cost: K decremental wakes chained in one program.
+
+wake_bench.py measures the end-to-end wake, but on this host's axon
+transport every value readback pays a ~70ms sync floor — any sub-100ms
+per-wake cost drowns in it.  This probe pre-stages K wakes of churn as
+device arrays (flag/recv scatters, layout mask scatters, suspect/fresh
+words, xla-tier pair snapshots), scans the raw wake function over them
+inside ONE jitted program, and times chain(K) against chain(2): the
+difference divided by K-2 cancels the sync floor and the fixed
+dispatch cost, leaving the true device per-wake time — the number the
+<=10ms BASELINE target is judged against.
+
+Per wake: half removals of live base pairs (masked in-layout + suspect
+words), half fresh inserts (riding an xla tier whose cumulative per-wake
+snapshot is pre-staged), plus a batch of flag/recv scatters (halts,
+busy toggles, recv drains — the seed-churn suspects).  The final chain
+state is cross-checked against the numpy oracle.
+
+Usage: python tools/wake_chain_bench.py [--actors N] [--wakes 16]
+       [--churn 20000] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--actors", type=int, default=None)
+    ap.add_argument("--wakes", type=int, default=16)
+    ap.add_argument("--churn", type=int, default=20_000)
+    ap.add_argument("--small", action="store_true")
+    ap.add_argument("--no-oracle", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from uigc_tpu.models import powerlaw_actor_graph
+    from uigc_tpu.ops import pallas_decremental as pdec
+    from uigc_tpu.ops import pallas_trace as pt
+    from uigc_tpu.ops import trace as trace_ops
+    from uigc_tpu.utils.platform import apply_platform_override, is_tpu_platform
+
+    apply_platform_override()
+    platform = jax.devices()[0].platform
+    on_tpu = is_tpu_platform(platform)
+    n = args.actors or (10_000_000 if on_tpu and not args.small else 1 << 16)
+    K = args.wakes
+    churn = args.churn if not args.small else min(args.churn, 512)
+
+    rng = np.random.default_rng(11)
+    graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=0.5)
+    flags0 = graph["flags"]
+    recv0 = graph["recv_count"]
+
+    # --- static base layout (no pow2 padding: fixed geometry) -------- #
+    from uigc_tpu.ops.pallas_incremental import IncrementalPallasLayout
+
+    psrc, pdst, kinds = IncrementalPallasLayout.pairs_from_graph(
+        graph["edge_src"], graph["edge_dst"], graph["edge_weight"],
+        graph["supervisor"],
+    )
+    t0 = time.perf_counter()
+    prep = pt.prepare_pairs(psrc, pdst, n, want_slots=True)
+    pack_s = time.perf_counter() - t0
+    slot_ri = prep.pop("slot_ri")
+    slot_col = prep.pop("slot_col")
+    r_rows = prep["r_rows"]
+    n_words_pad = r_rows * pt.LANE
+
+    # the xla tier accumulates every insert across the chain
+    cap = 1 << max(10, int(K * churn // 2 - 1).bit_length())
+    xla = pt.xla_tier([], [], n, cap)
+    specs = (pt.layout_spec(prep), pt.layout_spec(xla))
+    wake_raw = pdec.get_wake_fn(
+        n, specs, prep["n_super"], r_rows, prep["s_rows"]
+    ).raw
+
+    # --- pre-stage K wakes of churn ---------------------------------- #
+    d_half, i_half = churn // 2, churn // 2
+    removable = np.nonzero(kinds == 0)[0]
+    removed = np.zeros(psrc.size, bool)
+    base_keys = set(zip(psrc.tolist(), pdst.tolist()))
+    ins_pairs: list = []
+
+    f_churn = max(16, churn // 8)
+    flag_slots = np.full((K, f_churn), n, np.int32)  # pad = dropped
+    flag_vals = np.zeros((K, f_churn), np.uint8)
+    recv_slots = np.full((K, f_churn), n, np.int32)
+    recv_vals = np.zeros((K, f_churn), np.int64)
+    mask_rows = np.full((K, d_half), prep["row_pos"].shape[0], np.int32)
+    mask_cols = np.zeros((K, d_half), np.int32)
+    del_words = np.zeros((K, r_rows, pt.LANE), np.uint32)
+    fresh_words = np.zeros((K, r_rows, pt.LANE), np.uint32)
+    xsrc = np.full((K, cap), n, np.int32)
+    xdst = np.full((K, cap), n, np.int32)
+
+    def set_bits(words, ids):
+        ids = np.asarray(ids, np.int64)
+        if ids.size:
+            flat = words.reshape(-1)
+            np.bitwise_or.at(
+                flat, ids >> 5, np.uint32(1) << (ids & 31).astype(np.uint32)
+            )
+
+    F = trace_ops
+    flags_now = flags0.copy()
+    recv_now = recv0.copy()
+    n_ins_total = 0
+    for k in range(K):
+        # flag/recv churn: halts, busy toggles, recv drains/arrivals
+        for j in range(f_churn):
+            i = int(rng.integers(0, n))
+            r = rng.random()
+            if r < 0.3:
+                flags_now[i] |= F.FLAG_HALTED
+            elif r < 0.7:
+                flags_now[i] ^= F.FLAG_BUSY
+            else:
+                recv_now[i] = 0 if recv_now[i] else 2
+                recv_slots[k, j] = i
+                recv_vals[k, j] = recv_now[i]
+                continue
+            flag_slots[k, j] = i
+            flag_vals[k, j] = flags_now[i]
+        cand = rng.choice(removable, d_half, replace=False)
+        cand = cand[~removed[cand]]
+        removed[cand] = True
+        mask_rows[k, : cand.size] = slot_ri[cand]
+        mask_cols[k, : cand.size] = slot_col[cand]
+        set_bits(del_words[k], pdst[cand])
+
+        fresh = []
+        while len(fresh) < i_half and n_ins_total + len(fresh) < cap:
+            s_, d_ = int(rng.integers(0, n)), int(rng.integers(0, n))
+            if (s_, d_) not in base_keys:
+                base_keys.add((s_, d_))
+                fresh.append((s_, d_))
+        ins_pairs.extend(fresh)
+        n_ins_total = len(ins_pairs)
+        # tier snapshot at wake k = every insert so far
+        xsrc[k, :n_ins_total] = [p[0] for p in ins_pairs]
+        xdst[k, :n_ins_total] = [p[1] for p in ins_pairs]
+        set_bits(fresh_words[k], [p[1] for p in fresh])
+
+    dev = {
+        "bmeta1": jax.device_put(prep["bmeta1"]),
+        "bmeta2": jax.device_put(prep["bmeta2"]),
+        "row_pos": jax.device_put(prep["row_pos"]),
+        "emeta": jax.device_put(prep["emeta"]),
+        "mask_rows": jax.device_put(mask_rows),
+        "mask_cols": jax.device_put(mask_cols),
+        "del_w": jax.device_put(del_words.view(np.int32)),
+        "fresh_w": jax.device_put(fresh_words.view(np.int32)),
+        "xsrc": jax.device_put(xsrc),
+        "xdst": jax.device_put(xdst),
+        "flags": jax.device_put(flags0),
+        "recv": jax.device_put(recv0),
+        "flag_slots": jax.device_put(flag_slots),
+        "flag_vals": jax.device_put(flag_vals),
+        "recv_slots": jax.device_put(recv_slots),
+        "recv_vals": jax.device_put(recv_vals),
+    }
+    zeros_w = jnp.zeros((r_rows, pt.LANE), jnp.int32)
+
+    @jax.jit
+    def chained(k_hi, row_pos, emeta):
+        state0 = (zeros_w,) * 5
+
+        def body(k, carry):
+            flags, recv, row_pos, emeta, state = carry
+            # in-chain churn: node-feature scatters + layout slot masks
+            flags = flags.at[dev["flag_slots"][k]].set(
+                dev["flag_vals"][k], mode="drop"
+            )
+            recv = recv.at[dev["recv_slots"][k]].set(
+                dev["recv_vals"][k], mode="drop"
+            )
+            rows = dev["mask_rows"][k]
+            cols = dev["mask_cols"][k]
+            row_pos = row_pos.at[rows, cols].set(pt._PAD_ROW, mode="drop")
+            emeta = emeta.at[rows, cols].set(0, mode="drop")
+            state = wake_raw(
+                flags,
+                recv,
+                dev["del_w"][k],
+                dev["fresh_w"][k],
+                *state,
+                dev["bmeta1"],
+                dev["bmeta2"],
+                row_pos,
+                emeta,
+                dev["xsrc"][k],
+                dev["xdst"][k],
+            )
+            return (flags, recv, row_pos, emeta, state)
+
+        flags, recv, row_pos, emeta, state = jax.lax.fori_loop(
+            0, k_hi, body, (dev["flags"], dev["recv"], row_pos, emeta, state0)
+        )
+        # data dependency on the final marks
+        return jnp.sum(state[0]), state
+
+    def run(k_hi):
+        t0 = time.perf_counter()
+        acc, state = chained(k_hi, dev["row_pos"], dev["emeta"])
+        int(acc)  # readback sync
+        return time.perf_counter() - t0, state
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)
+    log(f"pack {pack_s:.1f}s; compiling chain...")
+    run(2)  # compile + warmup
+    ts = []
+    for _ in range(3):
+        t_short, _ = run(2)
+        t_long, state = run(K)
+        ts.append((t_long - t_short) / (K - 2))
+    per_wake_ms = statistics.median(ts) * 1e3
+
+    result = {
+        "bench": "wake_chain",
+        "n_actors": n,
+        "n_pairs": int(prep["n_pairs"]),
+        "wakes_chained": K,
+        "churn_per_wake": churn,
+        "platform": platform,
+        "host_pack_s": round(pack_s, 2),
+        "device_per_wake_ms": round(per_wake_ms, 3),
+        "target_p50_ms": 10.0,
+        "vs_target": round(10.0 / max(per_wake_ms, 1e-9), 4),
+    }
+
+    if not args.no_oracle:
+        # oracle on the final state: unpack marks from the chained state
+        mark_w = np.asarray(state[0])
+        shifts = np.arange(32, dtype=np.int64)
+        bits = (mark_w.reshape(-1).astype(np.int64)[:, None] >> shifts) & 1
+        got = bits.reshape(-1)[:n] > 0
+        live = ~removed
+        allsrc = np.concatenate([psrc[live], np.array([p[0] for p in ins_pairs], np.int64)])
+        alldst = np.concatenate([pdst[live], np.array([p[1] for p in ins_pairs], np.int64)])
+        expected = trace_ops.trace_marks_np(
+            flags_now, recv_now, np.full(n, -1, np.int32),
+            allsrc, alldst, np.ones(allsrc.size, np.int64),
+        )
+        result["oracle_ok"] = bool(np.array_equal(got, expected))
+
+    print(json.dumps(result))
+    if not args.no_oracle and not result["oracle_ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

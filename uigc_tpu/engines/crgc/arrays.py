@@ -632,7 +632,14 @@ class ArrayShadowGraph:
             lambda: pallas_decremental.DecrementalTracer(self.capacity),
             lambda d: d.layout.needs_repack,
         )
-        return self._dec.marks(self.flags, self.recv_count)
+        try:
+            return self._dec.marks(self.flags, self.recv_count)
+        except Exception:
+            # A poisoned async result surfaces at the readback inside
+            # marks(), after the tracer committed state; drop it so the
+            # next wake re-derives instead of feeding poisoned arrays.
+            self._dec.invalidate()
+            raise
 
     def trace(self, should_kill: bool) -> int:
         with events.recorder.timed(events.TRACING) as ev:

@@ -212,10 +212,15 @@ def _build_wake_fn(
         )
         return mark_w, seed_w, halted_w, iu_w, table
 
-    return jax.jit(wake_fn)
+    jitted = jax.jit(wake_fn)
+    jitted.raw = wake_fn  # unjitted body, for callers composing it
+    return jitted
 
 
 def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None):
+    """Cached jitted wake fn; its ``raw`` attribute is the unjitted body
+    for callers that compose wakes inside a larger program (the chained
+    wake benchmark scans K of them in one jit)."""
     if interpret is None:
         interpret = pt.default_interpret()
     key = (n, tuple(specs), n_super, r_rows, s_rows, interpret)

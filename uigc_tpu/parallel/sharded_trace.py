@@ -126,7 +126,7 @@ def _shard_map_compat(local_fn, mesh, in_specs, out_specs):
         )
 
 
-def _seed_masks(flags, recv, jnp):
+def _seed_masks(flags, recv):
     """(in_use, halted, seed) bool vectors from the node features — the
     one seed definition every trace variant shares (reference semantics:
     ShadowGraph.java:205-220)."""
@@ -196,7 +196,7 @@ def make_local_shard_ops(axis, words_pad, r_rows, n_pad, shard_size, jnp):
 
         return sweep_hits
 
-    return pack_words, gather_table, src_bits, make_sweep
+    return pack_words, gather_table, make_sweep
 
 
 def make_sharded_trace(mesh, axis: str = "gc"):
@@ -419,10 +419,10 @@ def make_sharded_pallas_trace(
         bsrc = bsrc.reshape(-1)
         bdst = bdst.reshape(-1)
 
-        in_use, halted, seed = _seed_masks(flags, recv, jnp)
+        in_use, halted, seed = _seed_masks(flags, recv)
         mark0 = in_use & (~halted) & seed
 
-        pack_words, gather_table, _, make_sweep = make_local_shard_ops(
+        pack_words, gather_table, make_sweep = make_local_shard_ops(
             axis, words_pad, r_rows, n_pad, shard_size, jnp
         )
         sweep_hits = make_sweep(
@@ -647,8 +647,8 @@ def make_sharded_decremental_wake(
         bsrc = bsrc.reshape(-1)
         bdst = bdst.reshape(-1)
 
-        in_use, halted, seed = _seed_masks(flags, recv, jnp)
-        pack_words, gather_table, _, make_sweep = make_local_shard_ops(
+        in_use, halted, seed = _seed_masks(flags, recv)
+        pack_words, gather_table, make_sweep = make_local_shard_ops(
             axis, words_pad, r_rows, n_pad, shard_size, jnp
         )
         sweep_hits = make_sweep(
