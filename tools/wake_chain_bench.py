@@ -42,6 +42,8 @@ def main():
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--no-oracle", action="store_true")
     args = ap.parse_args()
+    if args.wakes < 3:
+        ap.error("--wakes must be >= 3 (chain(2) is the baseline)")
 
     import jax
     import jax.numpy as jnp
@@ -91,7 +93,10 @@ def main():
     d_half, i_half = churn // 2, churn // 2
     removable = np.nonzero(kinds == 0)[0]
     removed = np.zeros(psrc.size, bool)
-    base_keys = set(zip(psrc.tolist(), pdst.tolist()))
+    # membership via a sorted packed-key array: a Python set of ~30M
+    # tuples would cost GBs of host RAM at the 10M-actor default
+    base_sorted = np.sort((psrc << 32) | pdst)
+    new_keys: set = set()
     ins_pairs: list = []
 
     f_churn = max(16, churn // 8)
@@ -119,21 +124,30 @@ def main():
     recv_now = recv0.copy()
     n_ins_total = 0
     for k in range(K):
-        # flag/recv churn: halts, busy toggles, recv drains/arrivals
-        for j in range(f_churn):
+        # flag/recv churn: halts, busy toggles, recv drains/arrivals.
+        # Staged per-wake as dicts so duplicate slots keep only the LAST
+        # value — .at[].set with repeated indices applies in undefined
+        # order on device, which would diverge from the host truth.
+        f_updates: dict = {}
+        r_updates: dict = {}
+        for _ in range(f_churn):
             i = int(rng.integers(0, n))
             r = rng.random()
             if r < 0.3:
                 flags_now[i] |= F.FLAG_HALTED
+                f_updates[i] = flags_now[i]
             elif r < 0.7:
                 flags_now[i] ^= F.FLAG_BUSY
+                f_updates[i] = flags_now[i]
             else:
                 recv_now[i] = 0 if recv_now[i] else 2
-                recv_slots[k, j] = i
-                recv_vals[k, j] = recv_now[i]
-                continue
+                r_updates[i] = recv_now[i]
+        for j, (i, v) in enumerate(f_updates.items()):
             flag_slots[k, j] = i
-            flag_vals[k, j] = flags_now[i]
+            flag_vals[k, j] = v
+        for j, (i, v) in enumerate(r_updates.items()):
+            recv_slots[k, j] = i
+            recv_vals[k, j] = v
         cand = rng.choice(removable, d_half, replace=False)
         cand = cand[~removed[cand]]
         removed[cand] = True
@@ -144,9 +158,14 @@ def main():
         fresh = []
         while len(fresh) < i_half and n_ins_total + len(fresh) < cap:
             s_, d_ = int(rng.integers(0, n)), int(rng.integers(0, n))
-            if (s_, d_) not in base_keys:
-                base_keys.add((s_, d_))
-                fresh.append((s_, d_))
+            key = (s_ << 32) | d_
+            if key in new_keys:
+                continue
+            pos = np.searchsorted(base_sorted, key)
+            if pos < base_sorted.size and base_sorted[pos] == key:
+                continue
+            new_keys.add(key)
+            fresh.append((s_, d_))
         ins_pairs.extend(fresh)
         n_ins_total = len(ins_pairs)
         # tier snapshot at wake k = every insert so far

@@ -248,6 +248,22 @@ def default_geometry(interpret: bool | None = None) -> tuple:
     return (SUB_CPU, GROUP_CPU) if interpret else (SUB_TPU, GROUP_TPU)
 
 
+def _parallel_argsort(keys: np.ndarray) -> np.ndarray:
+    """argsort through torch's multi-threaded sort when available —
+    numpy's is single-threaded and dominates the 50M-pair pack (~9s vs
+    ~2s).  Equal keys may land in either order; the packer's placement
+    is valid under any tie-break (the composite key carries every field
+    the placement reads)."""
+    if keys.size < (1 << 20):
+        return np.argsort(keys)
+    try:
+        import torch
+
+        return torch.from_numpy(keys).argsort().numpy()
+    except Exception:
+        return np.argsort(keys)
+
+
 def _pad_blocks_target(n_blocks: int) -> int:
     """Padded block count for a mutable layout: power of two while small
     (maximum kernel-cache reuse), then multiples of ``_BLOCK_QUANTUM``.
@@ -352,12 +368,24 @@ def prepare_pairs(
 
     m = psrc.size
     word = psrc >> 5
-    w_row = (word >> 7).astype(np.int32)
-    w_lane = (word & 127).astype(np.int32)
-    w_bit = (psrc & 31).astype(np.int32)
-    d_super = (pdst // super_sz).astype(np.int64)
-    d_local = (pdst % super_sz).astype(np.int64)
-    r8 = (w_row & 7).astype(np.int64)
+    w_row = word >> 7
+    if super_sz & (super_sz - 1) == 0:
+        # pow2 supertile (any pow2 s_rows): shifts instead of int64
+        # division, which costs whole seconds at 50M pairs
+        ss = super_sz.bit_length() - 1
+        d_super = pdst >> ss
+        d_local = pdst & (super_sz - 1)
+    else:
+        d_super = pdst // super_sz
+        d_local = pdst % super_sz
+    # per-pair emeta value, computed pre-sort so the sort permutation
+    # needs only two gathers (composite + this) instead of six
+    eval32 = (
+        (word & 127)
+        | ((psrc & 31) << 7)
+        | ((d_local & 127) << 12)
+        | ((d_local >> 7) << 19)
+    ).astype(np.int32)
 
     if compact_supers:
         touched = np.unique(d_super)
@@ -378,15 +406,22 @@ def prepare_pairs(
     # a 3-key lexsort: a third of the sorting passes on the 50M-pair
     # packs, and equal keys are interchangeable so stability is not
     # needed (w_row fits 31 bits for any graph the span packing admits).
-    composite = (d_super << 34) | (r8 << 31) | w_row
-    order = np.argsort(composite)
-    w_row, w_lane, w_bit = w_row[order], w_lane[order], w_bit[order]
-    d_super, d_local, r8 = d_super[order], d_local[order], r8[order]
+    # The key also CARRIES d_super/r8/w_row, so the sorted values are
+    # recovered by bit ops on one gathered array instead of per-field
+    # gathers.
+    composite = (d_super << 34) | ((w_row & 7) << 31) | w_row
+    order = _parallel_argsort(composite)
+    comp_s = composite[order]
+    eval32 = eval32[order]
+    w_row = (comp_s & ((1 << 31) - 1)).astype(np.int32)
+    r8 = (comp_s >> 31) & 7
+    d_super = comp_s >> 34
 
     # rank of each edge within its (d_super, r8) class
     if m:
         key_change = np.ones(m, dtype=bool)
-        key_change[1:] = (d_super[1:] != d_super[:-1]) | (r8[1:] != r8[:-1])
+        cls = comp_s >> 31  # (d_super, r8) in one compare
+        key_change[1:] = cls[1:] != cls[:-1]
         start_idx = np.nonzero(key_change)[0]
         starts = np.repeat(start_idx, np.diff(np.append(start_idx, m)))
         rank = np.arange(m, dtype=np.int64) - starts
@@ -395,9 +430,14 @@ def prepare_pairs(
 
     # blocks needed per (compact) supertile = max over classes of
     # ceil(ceil(class/128)/sub)
+    sub_shift = sub.bit_length() - 1 if sub & (sub - 1) == 0 else None
     blocks_needed = np.zeros(n_tiles, dtype=np.int64)
     if m:
-        np.maximum.at(blocks_needed, d_super, (rank // LANE) // sub + 1)
+        sub_seq = (
+            (rank >> 7) >> sub_shift if sub_shift is not None
+            else (rank >> 7) // sub
+        )
+        np.maximum.at(blocks_needed, d_super, sub_seq + 1)
     blocks_needed = np.maximum(blocks_needed, 1)  # dummy for empty supertiles
 
     n_blocks = int(blocks_needed.sum())
@@ -411,11 +451,16 @@ def prepare_pairs(
 
     slot_ri = slot_col = None
     if m:
-        sub_idx = rank // LANE  # sub-block sequence within the class
-        g_block = block_base[d_super] + sub_idx // sub
-        col = rank % LANE
+        sub_idx = rank >> 7  # sub-block sequence within the class
+        g_block = block_base[d_super] + (
+            sub_idx >> sub_shift if sub_shift is not None else sub_idx // sub
+        )
+        col = rank & 127
         # slot row = (sub-block within grid block, source row mod 8)
-        ri = g_block * block_rows + (sub_idx % sub) * ROWS + r8
+        sub_in = (
+            sub_idx & (sub - 1) if sub_shift is not None else sub_idx % sub
+        )
+        ri = g_block * block_rows + sub_in * ROWS + r8
         if want_slots:
             # Undo the placement sort: slot of the i-th *input* pair.
             slot_ri = np.empty(m, dtype=np.int64)
@@ -423,14 +468,12 @@ def prepare_pairs(
             slot_ri[order] = ri
             slot_col[order] = col
         row_pos[ri, col] = w_row
-        emeta[ri, col] = (
-            w_lane
-            | (w_bit << 7)
-            | ((d_local & 127).astype(np.int32) << 12)
-            | ((d_local >> 7).astype(np.int32) << 19)
-        )
+        emeta[ri, col] = eval32
         # per-block table walk-group range
-        chunk = (w_row // group_rows).astype(np.int64)
+        if group_rows & (group_rows - 1) == 0:
+            chunk = (w_row >> (group_rows.bit_length() - 1)).astype(np.int64)
+        else:
+            chunk = (w_row // group_rows).astype(np.int64)
         c_lo = np.full(n_blocks, 1 << 30, dtype=np.int64)
         c_hi = np.zeros(n_blocks, dtype=np.int64)
         np.minimum.at(c_lo, g_block, chunk)
