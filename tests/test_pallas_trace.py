@@ -98,3 +98,58 @@ def test_wide_geometry_matches_oracle(seed, sub, group):
     )
     got = pallas_trace.trace_marks_prepared(flags, recv, prep)
     assert np.array_equal(got, expected)
+
+
+def test_int8_mxu_flag_parity():
+    """UIGC_KERNEL_INT8=1 (int8 one-hot contraction, int32 accumulation)
+    must produce oracle-identical marks.  Run in a subprocess: the flag
+    is read once at import so in-process toggling would desync the
+    kernel caches."""
+    import subprocess
+    import sys
+
+    _run_int8_subprocess(pin_cpu=True)
+
+
+def _run_int8_subprocess(pin_cpu: bool):
+    import os
+    import subprocess
+    import sys
+
+    code = """
+PIN_CPU
+import numpy as np
+from uigc_tpu.ops import pallas_trace, trace as trace_ops
+assert pallas_trace._INT8_MXU, "int8 flag did not take effect"
+import sys
+sys.path.insert(0, "tests")
+from test_pallas_trace import random_graph
+rng = np.random.default_rng(3)
+g = random_graph(rng, 1200, 5000)
+assert np.array_equal(
+    pallas_trace.trace_marks_pallas(*g), trace_ops.trace_marks_np(*g)
+)
+print("INT8 PARITY OK")
+""".replace(
+        "PIN_CPU",
+        'import jax\njax.config.update("jax_platforms", "cpu")'
+        if pin_cpu
+        else "",
+    )
+    env = dict(os.environ, UIGC_KERNEL_INT8="1")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=str(__import__("pathlib").Path(__file__).resolve().parent.parent),
+        timeout=500,
+    )
+    assert "INT8 PARITY OK" in out.stdout, out.stderr[-2000:]
+
+
+@pytest.mark.tpu
+def test_int8_mxu_compiled_parity():
+    """The int8 contraction through the real Mosaic lowering — interpret
+    mode cannot catch an int8-dot lowering failure."""
+    _run_int8_subprocess(pin_cpu=False)

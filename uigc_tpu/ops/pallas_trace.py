@@ -101,6 +101,21 @@ _BLOCK_QUANTUM = 8192
 PACK_FORMAT_VERSION = 2
 
 
+def _int8_mxu() -> bool:
+    """UIGC_KERNEL_INT8=1 runs the one-hot contraction in int8 with
+    int32 accumulation (A and B are 0/1, so it is exact) — on chips
+    whose MXU doubles int8 rate vs bf16 this is a candidate 2x when the
+    sweep is contraction-bound.  Read once at import so the kernel
+    caches stay consistent within a process; A/B by re-running the
+    bench with the env var set."""
+    import os
+
+    return os.environ.get("UIGC_KERNEL_INT8", "") not in ("", "0")
+
+
+_INT8_MXU = _int8_mxu()
+
+
 def pack_hits_words(hits2d, jnp):
     """Word-pack a (t, LANE) boolean hits plane into flat int32 words.
 
@@ -786,7 +801,9 @@ def build_propagate(
                 jnp.zeros((block_rows, LANE), jnp.int32),
             )
             bits = jax.lax.shift_right_logical(words, bit_pos) & 1
-            vals = bits.astype(jnp.bfloat16)
+            mm_dt = jnp.int8 if _INT8_MXU else jnp.bfloat16
+            acc_dt = jnp.int32 if _INT8_MXU else jnp.float32
+            vals = bits.astype(mm_dt)
 
             # Fused one-hot segment-sum on the MXU: one
             # (s_rows, block_rows*128) @ (block_rows*128, 128)
@@ -800,15 +817,17 @@ def build_propagate(
                 # vals is 0/1 bits, so the product is bit-identical to the
                 # select.
                 a_parts.append(
-                    (sub_iota == dst_sub[r, :][None, :]).astype(jnp.bfloat16)
+                    (sub_iota == dst_sub[r, :][None, :]).astype(mm_dt)
                     * vals[r, :][None, :]
                 )
                 b_parts.append(
-                    (lane_iota == dst_lane[r, :][:, None]).astype(jnp.bfloat16)
+                    (lane_iota == dst_lane[r, :][:, None]).astype(mm_dt)
                 )
             a = jnp.concatenate(a_parts, axis=1)  # (s_rows, block_rows*LANE)
             b = jnp.concatenate(b_parts, axis=0)  # (block_rows*LANE, LANE)
-            acc = jnp.dot(a, b, preferred_element_type=jnp.float32)
+            acc = jnp.dot(a, b, preferred_element_type=acc_dt)
+            if _INT8_MXU:
+                acc = acc.astype(jnp.float32)
 
             @pl.when(first)
             def _():
