@@ -162,3 +162,28 @@ def test_dead_root_does_not_leak_referents():
         )
     finally:
         kit.shutdown()
+
+
+def test_pipelined_decremental_collection():
+    """uigc.crgc.pipelined: the collector sweeps the previous wake's
+    verdicts while the next runs; cyclic garbage still collapses (a
+    consistent-snapshot verdict is never wrong — CRGC garbage is
+    monotone)."""
+    kit = ActorTestKit(
+        {
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.crgc.shadow-graph": "decremental",
+            "uigc.crgc.pipelined": True,
+        }
+    )
+    try:
+        probe = kit.create_test_probe(timeout_s=30.0)
+        root = kit.spawn(Behaviors.setup_root(lambda ctx: Root(ctx, probe)), "root")
+        probe.expect_message_type(Spawned)
+        probe.expect_message_type(Spawned)
+        probe.expect_no_message(0.2)
+        root.tell(Drop())
+        probe.expect_message_type(Stopped)
+        probe.expect_message_type(Stopped)
+    finally:
+        kit.shutdown()

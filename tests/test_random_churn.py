@@ -144,13 +144,27 @@ def random_actor_factory(shared):
 import pytest
 
 
-@pytest.mark.parametrize("backend", ["array", "decremental"])
-def test_random_churn_fully_collected(backend):
+@pytest.mark.parametrize(
+    "backend,pipelined",
+    [("array", False), ("decremental", False), ("decremental", True)],
+    ids=["array", "decremental", "decremental-pipelined"],
+)
+def test_random_churn_fully_collected(backend, pipelined):
     """Unsound GC kills live actors; incomplete GC times out.  The
     decremental variant must detect every released subgraph (incl.
-    cycles) by regional repair, never by luck of a full re-trace."""
+    cycles) by regional repair, never by luck of a full re-trace; the
+    pipelined variant additionally sweeps snapshot verdicts while the
+    next wake runs."""
     shared = Shared()
-    kit = ActorTestKit(dict(CONFIG, **{"uigc.crgc.shadow-graph": backend}))
+    kit = ActorTestKit(
+        dict(
+            CONFIG,
+            **{
+                "uigc.crgc.shadow-graph": backend,
+                "uigc.crgc.pipelined": pipelined,
+            },
+        )
+    )
     try:
         def make_root(timers):
             def setup(ctx):

@@ -50,6 +50,13 @@ DEFAULTS: Dict[str, Any] = {
     "uigc.crgc.shadow-graph": "array",
     # Devices in the mesh backend's mesh; 0 = all visible devices.
     "uigc.crgc.mesh-devices": 0,
+    # Pipelined collection: the collector dispatches the device wake
+    # asynchronously and sweeps the PREVIOUS wake's verdicts while the
+    # current one runs, overlapping host ingest with the device trace
+    # (SURVEY §7 hard parts).  Sound because CRGC garbage is monotone —
+    # a consistent-snapshot verdict never kills a live actor.  Only the
+    # decremental device backend supports it; others ignore the flag.
+    "uigc.crgc.pipelined": False,
     # --- MAC engine settings (reference: reference.conf:43-50) ---
     "uigc.mac.cycle-detection": False,
     # Milliseconds between cycle-detector wakeups (reference:

@@ -625,13 +625,7 @@ class ArrayShadowGraph:
         graph (ops/pallas_decremental.py; the steady-state analogue of
         the reference's 50ms incremental collect, LocalGC.scala:144-186,
         at scales where a full re-trace cannot meet the cadence)."""
-        from ...ops import pallas_decremental
-
-        self._dec = self._sync_layout(
-            self._dec,
-            lambda: pallas_decremental.DecrementalTracer(self.capacity),
-            lambda d: d.layout.needs_repack,
-        )
+        self._dec = self._synced_dec()
         try:
             return self._dec.marks(self.flags, self.recv_count)
         except Exception:
@@ -641,7 +635,130 @@ class ArrayShadowGraph:
             self._dec.invalidate()
             raise
 
+    # ------------------------------------------------------------- #
+    # Pipelined collection (SURVEY §7 "hard parts": the 50ms cadence
+    # can't meet a 10ms detection budget without overlapping host
+    # ingest and the device trace).  launch_trace() snapshots the node
+    # features and dispatches the device wake asynchronously;
+    # harvest_trace() later sweeps with the SNAPSHOT verdicts.  Sound
+    # because CRGC garbage is monotone: an actor unreachable and
+    # quiescent at any consistent snapshot can never be resurrected
+    # (only garbage held references to it), so acting on a stale
+    # verdict kills nothing live — and slots are freed only by the
+    # harvest itself, so the snapshot's slot bindings still hold.
+    # ------------------------------------------------------------- #
+
+    _pending_wake = None
+
+    @property
+    def can_pipeline(self) -> bool:
+        return self.use_device and self.decremental
+
+    @property
+    def has_pending_wake(self) -> bool:
+        return self._pending_wake is not None
+
+    def _synced_dec(self):
+        """The decremental tracer, synced with the pair log (the one
+        construction site for both the synchronous and pipelined
+        paths)."""
+        from ...ops import pallas_decremental
+
+        self._dec = self._sync_layout(
+            self._dec,
+            lambda: pallas_decremental.DecrementalTracer(self.capacity),
+            lambda d: d.layout.needs_repack,
+        )
+        return self._dec
+
+    def launch_trace(self) -> None:
+        """Dispatch the device wake without waiting for its result.
+        No-op while a wake is already in flight."""
+        import time
+
+        import jax
+
+        if self._pending_wake is not None:
+            return
+        dec = self._synced_dec()
+        mark_w = dec.wake_device(
+            jax.device_put(self.flags), jax.device_put(self.recv_count)
+        )
+        self._pending_wake = (
+            dec,
+            mark_w,
+            self.flags.copy(),
+            self.supervisor.copy(),
+            time.monotonic(),
+        )
+
+    def harvest_ready(self) -> bool:
+        if self._pending_wake is None:
+            return False
+        mark_w = self._pending_wake[1]
+        is_ready = getattr(mark_w, "is_ready", None)
+        return bool(is_ready()) if is_ready is not None else True
+
+    def expire_stalled_wake(self, max_age_s: float) -> bool:
+        """A wake whose device result never lands (wedged transport)
+        must not deadlock the pipeline: past ``max_age_s`` the pending
+        wake is abandoned and the tracer invalidated, so the next wake
+        is a clean full re-derivation.  Returns True if expired."""
+        import time
+
+        if self._pending_wake is None:
+            return False
+        dec, _, _, _, t0 = self._pending_wake
+        if time.monotonic() - t0 < max_age_s:
+            return False
+        self._pending_wake = None
+        dec.invalidate()
+        return True
+
+    def harvest_trace(self, should_kill: bool) -> int:
+        """Sweep with the pending wake's verdicts against its snapshot.
+        Returns the number of garbage actors (0 if nothing pending)."""
+        if self._pending_wake is None:
+            return 0
+        dec, mark_w, snap_flags, snap_sup, _ = self._pending_wake
+        self._pending_wake = None
+        with events.recorder.timed(events.TRACING) as ev:
+            try:
+                mark = np.asarray(dec.unpack_marks(mark_w))
+            except Exception:
+                dec.invalidate()
+                raise
+            garbage, kill = trace_ops.garbage_and_kills_np(
+                snap_flags, snap_sup, mark
+            )
+            if garbage.shape[0] < self.capacity:
+                # capacity grew between launch and harvest: slots beyond
+                # the snapshot were interned after it, so they carry no
+                # verdict (not garbage) — pad so the sweep's edge scans
+                # index the grown arrays safely
+                pad = np.zeros(self.capacity - garbage.shape[0], bool)
+                garbage = np.concatenate([garbage, pad])
+                kill = np.concatenate([kill, pad])
+            garbage_slots = np.nonzero(garbage)[0]
+            kill_slots = np.nonzero(kill)[0]
+            if should_kill:
+                cells = self.cells
+                for slot in kill_slots.tolist():
+                    cells[slot].tell(StopMsg)
+            if garbage_slots.size:
+                self._free_slots_batch(garbage, garbage_slots)
+            ev.fields["num_garbage_actors"] = int(garbage_slots.size)
+            ev.fields["num_live_actors"] = int(np.count_nonzero(mark))
+        return int(garbage_slots.size)
+
     def trace(self, should_kill: bool) -> int:
+        # A synchronous trace sweeps against CURRENT state; an
+        # unharvested pipelined wake would later sweep a snapshot whose
+        # slot bindings this sweep is about to invalidate (freed or
+        # re-interned slots) — discard it.  Nothing is lost: the fresh
+        # verdicts computed here are a superset of the snapshot's
+        # (garbage is monotone).
+        self._pending_wake = None
         with events.recorder.timed(events.TRACING) as ev:
             mark = self.compute_marks()
             garbage, kill = trace_ops.garbage_and_kills_np(

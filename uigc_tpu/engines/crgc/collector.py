@@ -328,7 +328,24 @@ class Bookkeeper(RawBehavior):
                 self.finalize_delta_graph()
             ev.fields["num_entries"] = count
         self.total_entries += count
-        self.shadow_graph.trace(should_kill=True)
+        graph = self.shadow_graph
+        if self.engine.pipelined and getattr(graph, "can_pipeline", False):
+            # Pipelined: sweep the previous wake's verdicts (if its
+            # device result landed), then dispatch the next wake and
+            # return — the device traces while the mutators keep
+            # folding (SURVEY §7; sound because CRGC garbage is
+            # monotone, see ArrayShadowGraph.launch_trace).  A wake
+            # whose result never lands is expired so a transport outage
+            # cannot deadlock collection forever.
+            if graph.harvest_ready():
+                graph.harvest_trace(should_kill=True)
+            else:
+                graph.expire_stalled_wake(
+                    max(30.0, self.engine.wakeup_interval_ms / 1000.0 * 20)
+                )
+            graph.launch_trace()
+        else:
+            graph.trace(should_kill=True)
         return count
 
     def finalize_delta_graph(self) -> None:

@@ -55,6 +55,7 @@ class CRGC(Engine):
             "uigc.crgc.egress-finalize-interval"
         )
         self.shadow_graph_impl = config.get_string("uigc.crgc.shadow-graph")
+        self.pipelined = config.get_bool("uigc.crgc.pipelined")
 
         # Mutator->collector channel + entry free list.  CPython deque
         # append/popleft are atomic, giving the lock-free MPSC hand-off the

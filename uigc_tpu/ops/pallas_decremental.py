@@ -373,12 +373,10 @@ class DecrementalTracer:
         self._pending_del_dst.clear()
         self._pending_fresh_dst.clear()
 
-    def marks(self, flags, recv_count) -> np.ndarray:
-        """Wake + unpack to the oracle's (n,) bool mark vector."""
+    def unpack_marks(self, mark_w) -> np.ndarray:
+        """Packed mark words -> the oracle's (n,) bool mark vector."""
         import jax
         import jax.numpy as jnp
-
-        mark_w = self.wake_device(jax.device_put(flags), jax.device_put(recv_count))
 
         if self._unpack is None:
 
@@ -388,3 +386,11 @@ class DecrementalTracer:
 
             self._unpack = unpack
         return np.asarray(self._unpack(mark_w))
+
+    def marks(self, flags, recv_count) -> np.ndarray:
+        """Wake + unpack to the oracle's (n,) bool mark vector."""
+        import jax
+
+        return self.unpack_marks(
+            self.wake_device(jax.device_put(flags), jax.device_put(recv_count))
+        )
