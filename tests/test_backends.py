@@ -187,3 +187,37 @@ def test_pipelined_decremental_collection():
         probe.expect_message_type(Stopped)
     finally:
         kit.shutdown()
+
+
+def test_pipelined_stalled_wake_expires():
+    """A wake whose device result never lands must expire (tracer
+    invalidated, pipeline freed) instead of deadlocking collection."""
+    import time
+
+    from uigc_tpu.engines.crgc.arrays import ArrayShadowGraph
+    from uigc_tpu.engines.crgc.state import CrgcContext
+
+    graph = ArrayShadowGraph(
+        CrgcContext(delta_graph_size=64, entry_field_size=4),
+        "uigc://test",
+        use_device=True,
+        decremental=True,
+    )
+
+    class NeverReady:
+        def is_ready(self):
+            return False
+
+    class FakeDec:
+        invalidated = False
+
+        def invalidate(self):
+            self.invalidated = True
+
+    dec = FakeDec()
+    graph._pending_wake = (dec, NeverReady(), None, None, time.monotonic() - 60)
+    assert not graph.harvest_ready()
+    assert not graph.expire_stalled_wake(max_age_s=120)  # too young
+    assert graph.has_pending_wake
+    assert graph.expire_stalled_wake(max_age_s=30)
+    assert dec.invalidated and not graph.has_pending_wake
