@@ -189,6 +189,36 @@ def test_pipelined_decremental_collection():
         kit.shutdown()
 
 
+def test_pipelined_mesh_decremental_falls_back_sync():
+    """uigc.crgc.pipelined + shadow-graph=mesh-decremental: the mesh
+    backend must NOT take the base-class pipelined path (its
+    launch_trace would route through the single-device tracer and
+    clear the _pair_log that _sync_device needs, desyncing the shard
+    layouts).  MeshShadowGraph.can_pipeline is False, so the collector
+    falls back to the synchronous sharded trace — and garbage still
+    collapses."""
+    kit = ActorTestKit(
+        {
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.crgc.shadow-graph": "mesh-decremental",
+            "uigc.crgc.pipelined": True,
+        }
+    )
+    try:
+        graph = kit.system.engine.bookkeeper.shadow_graph
+        assert graph.can_pipeline is False
+        probe = kit.create_test_probe(timeout_s=60.0)
+        root = kit.spawn(Behaviors.setup_root(lambda ctx: Root(ctx, probe)), "root")
+        probe.expect_message_type(Spawned)
+        probe.expect_message_type(Spawned)
+        root.tell(Drop())
+        probe.expect_message_type(Stopped)
+        probe.expect_message_type(Stopped)
+        assert not graph.has_pending_wake
+    finally:
+        kit.shutdown()
+
+
 def test_pipelined_stalled_wake_expires():
     """A wake whose device result never lands must expire (tracer
     invalidated, pipeline freed) instead of deadlocking collection."""

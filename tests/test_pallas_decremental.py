@@ -85,20 +85,26 @@ def _rand_schedule(rng, g, tracer, k):
             g.pairs[key] = None
             log.append((True, key[0], key[1], key[2]))
     tracer.apply_log(log)
-    # flag churn: seeds appear and disappear, nodes halt
+    # flag churn: seeds appear and disappear, nodes halt, slots free
+    # and get reused — both additive (iu & ~prev_iu supertile gate)
+    # and subtractive (~iu & prev_mark freed-slot suspects) in_use
+    # transitions must hit the wake's suspect paths.
     for _ in range(k // 2):
         i = int(rng.integers(0, g.n))
         r = rng.random()
-        if r < 0.3:
+        if r < 0.25:
             g.flags[i] ^= F.FLAG_BUSY
-        elif r < 0.5:
+        elif r < 0.4:
             g.flags[i] ^= F.FLAG_ROOT
-        elif r < 0.7:
+        elif r < 0.55:
             g.recv[i] = 0 if g.recv[i] else 3
-        elif r < 0.85:
+        elif r < 0.7:
             g.flags[i] |= F.FLAG_HALTED
-        else:
+        elif r < 0.85:
             g.flags[i] |= F.FLAG_IN_USE | F.FLAG_INTERNED
+        else:
+            # free the slot; a later iteration's IN_USE set is a reuse
+            g.flags[i] &= ~(F.FLAG_IN_USE | F.FLAG_HALTED)
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])

@@ -77,9 +77,23 @@ def main():
 
     cache_dir = Path(tempfile.gettempdir()) / f"uigc_prep_{os.getuid()}"
     cache_dir.mkdir(exist_ok=True)
+    # The key carries the graph model's identity (version + generator
+    # params), not just the pack format: a generator change must miss,
+    # or the benchmark silently measures a stale graph.
+    from uigc_tpu.models import graphgen
+
+    seed, frac = 0, 0.5
     cache = cache_dir / (
+        f"v{pt.PACK_FORMAT_VERSION}_g{graphgen.GRAPH_MODEL_VERSION}"
+        f"_s{seed}_f{frac}_{n}_{pt.S_ROWS}_{sub}_{group}.npz"
+    )
+    # One-time migration: the pre-model-keyed cache name for the same
+    # (unchanged, version-1) generator.
+    legacy = cache_dir / (
         f"v{pt.PACK_FORMAT_VERSION}_{n}_{pt.S_ROWS}_{sub}_{group}.npz"
     )
+    if graphgen.GRAPH_MODEL_VERSION == 1 and legacy.exists() and not cache.exists():
+        os.replace(legacy, cache)
     prep = None
     if cache.exists():
         try:
@@ -89,7 +103,7 @@ def main():
         except Exception:
             cache.unlink(missing_ok=True)  # poisoned cache: repack
     if prep is None:
-        graph = powerlaw_actor_graph(n, seed=0, garbage_fraction=0.5)
+        graph = powerlaw_actor_graph(n, seed=seed, garbage_fraction=frac)
         t0 = time.perf_counter()
         prep = pt.prepare_chunks(
             graph["edge_src"].astype(np.int32),

@@ -723,11 +723,9 @@ class ArrayShadowGraph:
         dec, mark_w, snap_flags, snap_sup, _ = self._pending_wake
         self._pending_wake = None
         with events.recorder.timed(events.TRACING) as ev:
-            try:
-                mark = np.asarray(dec.unpack_marks(mark_w))
-            except Exception:
-                dec.invalidate()
-                raise
+            # unpack_marks auto-invalidates the tracer on readback
+            # failure, so a poisoned wake needs no handling here.
+            mark = np.asarray(dec.unpack_marks(mark_w))
             garbage, kill = trace_ops.garbage_and_kills_np(
                 snap_flags, snap_sup, mark
             )

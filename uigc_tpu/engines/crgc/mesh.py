@@ -124,6 +124,28 @@ class MeshShadowGraph(ArrayShadowGraph):
         self._jit_cache: Dict[str, object] = {}
         self._trace_cache: Dict[tuple, object] = {}
 
+    @property
+    def can_pipeline(self) -> bool:
+        # The base-class pipelined path (launch_trace/harvest_trace)
+        # routes through the single-device DecrementalTracer and its
+        # _sync_layout clears self._pair_log, which _sync_device still
+        # needs — permanently desyncing the sharded layouts.  Until the
+        # mesh grows its own launch/harvest pair, pipelined collection
+        # must fall back to the synchronous sharded trace here.
+        return False
+
+    def launch_trace(self) -> None:
+        raise NotImplementedError(
+            "MeshShadowGraph has no pipelined wake: the inherited "
+            "launch_trace would desync the shard layouts (see "
+            "can_pipeline)"
+        )
+
+    def harvest_trace(self, should_kill: bool) -> int:
+        raise NotImplementedError(
+            "MeshShadowGraph has no pipelined wake (see can_pipeline)"
+        )
+
     # ------------------------------------------------------------- #
     # Device state construction
     # ------------------------------------------------------------- #

@@ -374,7 +374,14 @@ class DecrementalTracer:
         self._pending_fresh_dst.clear()
 
     def unpack_marks(self, mark_w) -> np.ndarray:
-        """Packed mark words -> the oracle's (n,) bool mark vector."""
+        """Packed mark words -> the oracle's (n,) bool mark vector.
+
+        This is the readback point where an async-poisoned wake (the
+        dispatch succeeded, the transport died before the result
+        landed) first surfaces.  The tracer auto-invalidates before
+        re-raising, so a caller that catches and retries without its
+        own invalidate() still gets a clean full re-derivation instead
+        of tracing from corrupt committed state."""
         import jax
         import jax.numpy as jnp
 
@@ -385,7 +392,11 @@ class DecrementalTracer:
                 return pt.unpack_table(words, self.n, jnp)
 
             self._unpack = unpack
-        return np.asarray(self._unpack(mark_w))
+        try:
+            return np.asarray(self._unpack(mark_w))
+        except Exception:
+            self.invalidate()
+            raise
 
     def marks(self, flags, recv_count) -> np.ndarray:
         """Wake + unpack to the oracle's (n,) bool mark vector."""
