@@ -57,6 +57,13 @@ DEFAULTS: Dict[str, Any] = {
     # a consistent-snapshot verdict never kills a live actor.  Only the
     # decremental device backend supports it; others ignore the flag.
     "uigc.crgc.pipelined": False,
+    # Packed mutator->collector entry plane (SURVEY §7): flushes write
+    # int64 rows into per-thread ring buffers instead of object Entries,
+    # so the Bookkeeper's fold is pure array work.  Automatically falls
+    # back to object entries when a fabric is attached (the multi-node
+    # fold builds delta graphs from objects) or when the backend has no
+    # array fold (oracle, native).
+    "uigc.crgc.packed-entries": True,
     # --- MAC engine settings (reference: reference.conf:43-50) ---
     "uigc.mac.cycle-detection": False,
     # Milliseconds between cycle-detector wakeups (reference:

@@ -30,6 +30,7 @@ import numpy as np
 
 from ...ops import pallas_incremental as pallas_incremental_kinds
 from ...ops import trace as trace_ops
+from ...ops.i64map import I64Map, IntStack
 from ...utils import events
 from .messages import StopMsg, WaveMsg
 from .state import CrgcContext, Entry
@@ -77,7 +78,19 @@ class ArrayShadowGraph:
         self.locations: List[Optional[str]] = [None] * cap
 
         self.slot_of: Dict["ActorCell", int] = {}
-        self.free_slots: List[int] = list(range(cap - 1, -1, -1))
+        self.free_slots = IntStack.from_range(0, cap)
+
+        #: packed-plane maps (merge_packed): dense uid -> slot, and the
+        #: reverse per-slot uid so freeing a slot invalidates its uid
+        #: mapping.  -1 = unmapped.
+        self._uid_to_slot = np.full(1024, -1, dtype=np.int64)
+        self._slot_uid = np.full(cap, -1, dtype=np.int64)
+        #: per-slot flush stamps guarding last-writer-wins writes
+        #: against out-of-order ring drains (see _apply_batch)
+        self._br_seq = np.full(cap, -1, dtype=np.int64)
+        self._sup_seq = np.full(cap, -1, dtype=np.int64)
+        self._plane = None
+        self._resolve_cell = None
 
         ecap = max(16, initial_capacity * 2)
         self.edge_capacity = ecap
@@ -87,9 +100,11 @@ class ArrayShadowGraph:
         #: packed (owner << 32 | target) int64 key -> edge id.  An edge is
         #: allocated iff its weight is nonzero, which is what lets the
         #: sweep find every edge incident to a garbage set with one
-        #: vectorized scan instead of per-slot incident sets.
-        self.edge_of: Dict[int, int] = {}
-        self.free_edges: List[int] = list(range(ecap - 1, -1, -1))
+        #: vectorized scan instead of per-slot incident sets.  A
+        #: vectorized hash table, not a dict: the fold's per-batch key
+        #: traffic is the collector's hottest map (ops/i64map.py).
+        self.edge_of = I64Map()
+        self.free_edges = IntStack.from_range(0, ecap)
 
         #: changelog of pair transitions since the Pallas layout last
         #: consumed it: (insert?, src, dst, kind).  ``None`` means either
@@ -122,7 +137,16 @@ class ArrayShadowGraph:
         )
         self.cells.extend([None] * old)
         self.locations.extend([None] * old)
-        self.free_slots.extend(range(new - 1, old - 1, -1))
+        self.free_slots.push_range(old, new)
+        self._slot_uid = np.concatenate(
+            [self._slot_uid, np.full(old, -1, dtype=np.int64)]
+        )
+        self._br_seq = np.concatenate(
+            [self._br_seq, np.full(old, -1, dtype=np.int64)]
+        )
+        self._sup_seq = np.concatenate(
+            [self._sup_seq, np.full(old, -1, dtype=np.int64)]
+        )
         self.capacity = new
         # Node capacity sets the bit-table/supertile geometry: the whole
         # Pallas layout must be rebuilt.
@@ -130,15 +154,24 @@ class ArrayShadowGraph:
         self._inc = None
         self._dec = None
 
-    def _grow_edges(self) -> None:
+    def _grow_edges(self, min_free: int = 1) -> None:
+        """Grow in one jump to whatever power-of-two capacity yields
+        ``min_free`` free ids — a large batch must not pay one
+        array-copy per doubling."""
         old = self.edge_capacity
         new = old * 2
-        self.edge_src = np.concatenate([self.edge_src, np.zeros(old, dtype=np.int32)])
-        self.edge_dst = np.concatenate([self.edge_dst, np.zeros(old, dtype=np.int32)])
-        self.edge_weight = np.concatenate(
-            [self.edge_weight, np.zeros(old, dtype=np.int64)]
+        while new - old + len(self.free_edges) < min_free:
+            new *= 2
+        self.edge_src = np.concatenate(
+            [self.edge_src, np.zeros(new - old, dtype=np.int32)]
         )
-        self.free_edges.extend(range(new - 1, old - 1, -1))
+        self.edge_dst = np.concatenate(
+            [self.edge_dst, np.zeros(new - old, dtype=np.int32)]
+        )
+        self.edge_weight = np.concatenate(
+            [self.edge_weight, np.zeros(new - old, dtype=np.int64)]
+        )
+        self.free_edges.push_range(old, new)
         self.edge_capacity = new
 
     # ------------------------------------------------------------- #
@@ -231,7 +264,7 @@ class ArrayShadowGraph:
             self._log_pair(False, owner, target, _PAIR_EDGE)
         self.edge_of.pop((owner << 32) | target, None)
         self.edge_weight[eid] = 0
-        self.free_edges.append(eid)
+        self.free_edges.push(eid)
 
     def _set_supervisor(self, child_slot: int, new_sup: int) -> None:
         old = int(self.supervisor[child_slot])
@@ -378,11 +411,47 @@ class ArrayShadowGraph:
                 if info & 1:  # deactivated (refob_info.is_active == False)
                     ek_append((self_slot << 32) | target_slot)
                     es_append(-1)
-        if self_slots:
-            sl = np.asarray(self_slots, dtype=np.int64)
-            rd = np.asarray(recv_deltas, dtype=np.int64)
+        self._apply_batch(
+            np.asarray(self_slots, dtype=np.int64),
+            np.asarray(busyroot, dtype=np.int64),
+            np.asarray(recv_deltas, dtype=np.int64),
+            np.asarray(ek, dtype=np.int64),
+            np.asarray(esign, dtype=np.int64),
+            np.asarray(sp_child, dtype=np.int64),
+            np.asarray(sp_parent, dtype=np.int64),
+        )
+
+    def _apply_batch(
+        self,
+        sl: np.ndarray,
+        br: np.ndarray,
+        rd: np.ndarray,
+        ek: np.ndarray,
+        esign: np.ndarray,
+        sp_child: np.ndarray,
+        sp_parent: np.ndarray,
+        sl_seq: Optional[np.ndarray] = None,
+        sp_seq: Optional[np.ndarray] = None,
+    ) -> None:
+        """The vectorized scatter-applies shared by both fold planes
+        (object entries and packed rows).
+
+        ``sl``/``br``/``rd`` run in queue order; rows with ``br == -1``
+        are recv-only (no busy/root write).  ``ek``/``esign`` are packed
+        ``owner << 32 | target`` edge keys with signs, order-free (only
+        net deltas matter).  ``sp_child``/``sp_parent`` run in queue
+        order (last writer wins a child's supervisor).
+
+        ``sl_seq``/``sp_seq`` (packed plane only): global flush stamps
+        for the last-writer-wins writes.  Per-thread rings drain
+        independently, so a LATER batch can carry an EARLIER flush of
+        the same actor — the stamps let the graph refuse stale busy/
+        root/supervisor writes across batches.  Additive facts (recv
+        sums, interning, net edge deltas) commute and need no guard.
+        The object plane passes None: its single FIFO queue already
+        totally orders flushes."""
+        if sl.size:
             np.add.at(self.recv_count, sl, rd)
-            br = np.asarray(busyroot, dtype=np.int64)
             selfrows = br >= 0
             ssl = sl[selfrows]
             sbr = br[selfrows]
@@ -392,19 +461,29 @@ class ArrayShadowGraph:
             u, ridx = np.unique(ssl[::-1], return_index=True)
             last_bits = sbr[::-1][ridx].astype(np.uint8)
             f = self.flags
+            interned = np.uint8(int(_F.FLAG_INTERNED) | int(_F.FLAG_LOCAL))
             keep = np.uint8(0xFF & ~(int(_F.FLAG_BUSY) | int(_F.FLAG_ROOT)))
-            f[u] = (
-                (f[u] | np.uint8(int(_F.FLAG_INTERNED) | int(_F.FLAG_LOCAL)))
-                & keep
-            ) | last_bits
+            if sl_seq is not None:
+                seqs = sl_seq[selfrows][::-1][ridx]
+                fresh = seqs >= self._br_seq[u]
+                self._br_seq[u[fresh]] = seqs[fresh]
+                # Interning is monotone — applies even for stale rows.
+                f[u] |= interned
+                uf = u[fresh]
+                f[uf] = (f[uf] & keep) | last_bits[fresh]
+            else:
+                f[u] = ((f[u] | interned) & keep) | last_bits
             if self._node_log is not None:
                 self._node_log.update(sl.tolist())
 
-        if sp_child:
-            ch = np.asarray(sp_child, dtype=np.int64)
-            pa = np.asarray(sp_parent, dtype=np.int64)
-            u, ridx = np.unique(ch[::-1], return_index=True)
-            newp = pa[::-1][ridx]
+        if sp_child.size:
+            u, ridx = np.unique(sp_child[::-1], return_index=True)
+            newp = sp_parent[::-1][ridx]
+            if sp_seq is not None:
+                seqs = sp_seq[::-1][ridx]
+                fresh = seqs >= self._sup_seq[u]
+                self._sup_seq[u[fresh]] = seqs[fresh]
+                u, newp = u[fresh], newp[fresh]
             old = self.supervisor[u].astype(np.int64)
             changed = old != newp
             uu, oo, nn = u[changed], old[changed], newp[changed]
@@ -413,23 +492,156 @@ class ArrayShadowGraph:
             self._log_pairs_batch(True, uu, nn, _PAIR_SUP)
             self.supervisor[uu] = nn
 
-        if ek:
-            karr = np.asarray(ek, dtype=np.int64)
-            sarr = np.asarray(esign, dtype=np.int64)
-            u, inv = np.unique(karr, return_inverse=True)
+        if ek.size:
+            u, inv = np.unique(ek, return_inverse=True)
             delta = np.zeros(u.size, dtype=np.int64)
-            np.add.at(delta, inv, sarr)
+            np.add.at(delta, inv, esign)
             nz = delta != 0
             self._apply_edge_deltas(u[nz], delta[nz])
+
+    # ------------------------------------------------------------- #
+    # Packed-plane fold (packed.py row layout)
+    # ------------------------------------------------------------- #
+
+    def attach_packed_plane(self, plane, resolve_cell) -> None:
+        """Wire the engine's packed plane in: ``plane.uid_strong`` pins
+        cells named by in-flight rows; ``resolve_cell`` (the system's
+        weak uid registry) is the fallback for uids whose pin was
+        already consumed."""
+        self._plane = plane
+        self._resolve_cell = resolve_cell
+
+    def _slots_for_uids(self, uids: np.ndarray) -> np.ndarray:
+        """Map uids -> slots through the dense array, interning unseen
+        uids (the only per-item Python in the packed fold, bounded by
+        the spawn rate rather than the flush rate).
+
+        An unresolvable uid maps to -1 and the caller drops the fields
+        naming it.  That is sound, not lossy: a uid resolves through
+        the plane's strong pin (held from flush until the actor's slot
+        is swept) or the system's weak registry (hit for any cell the
+        runtime still references, i.e. every live actor), so
+        unresolvable means the collector already PROVED the actor
+        garbage and swept it — and garbage is monotone, so late facts
+        about it (receive deltas, deactivations, edges) change nothing
+        the sweep has not already settled."""
+        m = self._uid_to_slot
+        maxu = int(uids.max(initial=0))
+        if maxu >= m.shape[0]:
+            grown = max(m.shape[0] * 2, maxu + 1)
+            m = np.concatenate(
+                [m, np.full(grown - m.shape[0], -1, dtype=np.int64)]
+            )
+            self._uid_to_slot = m
+        slots = m[uids]
+        missing = slots < 0
+        if missing.any():
+            us = self._plane.uid_strong
+            resolve = self._resolve_cell
+            for uid in np.unique(uids[missing]).tolist():
+                cell = us.get(uid)
+                if cell is None:
+                    cell = resolve(uid)
+                    if cell is None:
+                        continue  # proven-garbage uid: fields dropped
+                slot = self.slot_for(cell)
+                m[uid] = slot
+                self._slot_uid[slot] = uid
+            slots = m[uids]
+        return slots
+
+    def merge_packed(self, rows: np.ndarray) -> None:
+        """Fold a drained batch of packed rows: restore global flush
+        order from the seq column, map uids to slots, and run the same
+        vectorized scatter-applies as the object plane — semantically
+        ``merge_entry`` per row, in seq order, with flush stamps
+        guarding cross-batch staleness (see _apply_batch) and fields
+        naming proven-garbage uids dropped (see _slots_for_uids)."""
+        E = self.context.entry_field_size
+        order = np.argsort(rows[:, 0], kind="stable")
+        R = rows[order]
+
+        self_slots = self._slots_for_uids(R[:, 1])
+        if (self_slots < 0).any():
+            keep = self_slots >= 0
+            R = R[keep]
+            self_slots = self_slots[keep]
+        seq = R[:, 0]
+        bits = R[:, 2]
+        recv = R[:, 3]
+        c0 = 4
+        created = R[:, c0 : c0 + 2 * E]
+        spawned = R[:, c0 + 2 * E : c0 + 3 * E]
+        upd = R[:, c0 + 3 * E : c0 + 5 * E]
+
+        ow = created[:, 0::2].ravel()
+        tg = created[:, 1::2].ravel()
+        vc = ow >= 0
+        ow, tg = ow[vc], tg[vc]
+        ow_s = self._slots_for_uids(ow) if ow.size else ow
+        tg_s = self._slots_for_uids(tg) if tg.size else tg
+        cok = (ow_s >= 0) & (tg_s >= 0)
+        ow_s, tg_s = ow_s[cok], tg_s[cok]
+
+        sp = spawned.ravel()
+        vs = sp >= 0
+        sp_s = self._slots_for_uids(sp[vs]) if vs.any() else sp[vs]
+        sp_parent = np.repeat(self_slots, E)[vs]
+        sp_seq = np.repeat(seq, E)[vs]
+        sok = sp_s >= 0
+        sp_s, sp_parent, sp_seq = sp_s[sok], sp_parent[sok], sp_seq[sok]
+
+        ut = upd[:, 0::2].ravel()
+        ui = upd[:, 1::2].ravel()
+        vu = ut >= 0
+        ut_s = self._slots_for_uids(ut[vu]) if vu.any() else ut[vu]
+        uok = ut_s >= 0
+        ut_s = ut_s[uok]
+        uiv = ui[vu][uok]
+        upd_self = np.repeat(self_slots, E)[vu][uok]
+
+        # busy/root bit pairs -> flag bytes
+        lb = np.array(
+            [
+                0,
+                int(_F.FLAG_BUSY),
+                int(_F.FLAG_ROOT),
+                int(_F.FLAG_BUSY) | int(_F.FLAG_ROOT),
+            ],
+            dtype=np.int64,
+        )
+        br = lb[bits & 3]
+
+        send = uiv >> 1
+        has_send = send > 0
+        deact = (uiv & 1) == 1
+
+        sl = np.concatenate([self_slots, ut_s[has_send]])
+        brr = np.concatenate([br, np.full(int(has_send.sum()), -1, np.int64)])
+        rdd = np.concatenate([recv, -send[has_send]])
+        sl_seq = np.concatenate([seq, np.zeros(int(has_send.sum()), np.int64)])
+
+        ek = np.concatenate(
+            [(ow_s << 32) | tg_s, (upd_self[deact] << 32) | ut_s[deact]]
+        )
+        esign = np.concatenate(
+            [
+                np.ones(ow_s.size, dtype=np.int64),
+                np.full(int(deact.sum()), -1, dtype=np.int64),
+            ]
+        )
+
+        self._apply_batch(
+            sl, brr, rdd, ek, esign, sp_s, sp_parent,
+            sl_seq=sl_seq, sp_seq=sp_seq,
+        )
 
     def _apply_edge_deltas(self, keys: np.ndarray, deltas: np.ndarray) -> None:
         """Vectorized ``_update_edge`` over unique packed keys with
         nonzero net deltas: bulk id allocation, array scatter, batch dict
         update, and batched liveness-transition logging."""
         eo = self.edge_of
-        eids = np.fromiter(
-            (eo.get(k, -1) for k in keys.tolist()), np.int64, keys.size
-        )
+        eids = eo.get_batch(keys)
         existing = eids >= 0
 
         ex_eids = eids[existing]
@@ -461,23 +673,20 @@ class ArrayShadowGraph:
             if freed.any():
                 fr = ex_eids[freed]
                 w[fr] = 0
-                self.free_edges.extend(fr.tolist())
-                for k in ex_keys[freed].tolist():
-                    del eo[k]
+                self.free_edges.push_batch(fr)
+                eo.pop_batch(ex_keys[freed])
 
         new_keys = keys[~existing]
         if new_keys.size:
             d_new = deltas[~existing]
             need = int(new_keys.size)
-            while len(self.free_edges) < need:
-                self._grow_edges()
-            alloc = self.free_edges[-need:]
-            del self.free_edges[-need:]
-            aa = np.asarray(alloc, dtype=np.int64)
+            if len(self.free_edges) < need:
+                self._grow_edges(min_free=need)
+            aa = self.free_edges.pop_batch(need)
             self.edge_src[aa] = (new_keys >> 32).astype(np.int32)
             self.edge_dst[aa] = (new_keys & 0xFFFFFFFF).astype(np.int32)
             self.edge_weight[aa] = d_new
-            eo.update(zip(new_keys.tolist(), alloc))
+            eo.put_batch_new(new_keys, aa)
             pos = d_new > 0
             if pos.any():
                 self._log_pairs_batch(
@@ -808,12 +1017,11 @@ class ArrayShadowGraph:
                 keys = (self.edge_src[alive].astype(np.int64) << 32) | (
                     self.edge_dst[alive]
                 )
-                self.edge_of = dict(zip(keys.tolist(), alive.tolist()))
+                self.edge_of = I64Map.build(keys, alive)
             else:
-                for k in ((srcs.astype(np.int64) << 32) | dsts).tolist():
-                    eo.pop(k, None)
+                eo.pop_batch((srcs.astype(np.int64) << 32) | dsts)
                 w[eids] = 0
-            self.free_edges.extend(eids.tolist())
+            self.free_edges.push_batch(eids)
 
         sup = self.supervisor[garbage_slots]
         has_sup = sup >= 0
@@ -823,6 +1031,24 @@ class ArrayShadowGraph:
         self.supervisor[garbage_slots] = -1
         self.flags[garbage_slots] = 0
         self.recv_count[garbage_slots] = 0
+
+        # Invalidate packed-plane uid mappings and drop the strong pins
+        # for freed slots.  A proven-garbage actor can never matter
+        # again (CRGC garbage is monotone), so any later row naming its
+        # uid is droppable — _slots_for_uids handles the unresolvable
+        # case.  Slot reuse also resets the flush-stamp guards.
+        su = self._slot_uid
+        freed_uids = su[garbage_slots]
+        had_uid = freed_uids >= 0
+        if had_uid.any():
+            self._uid_to_slot[freed_uids[had_uid]] = -1
+            su[garbage_slots] = -1
+            if self._plane is not None:
+                pop = self._plane.uid_strong.pop
+                for uid in freed_uids[had_uid].tolist():
+                    pop(uid, None)
+        self._br_seq[garbage_slots] = -1
+        self._sup_seq[garbage_slots] = -1
 
         cells = self.cells
         locations = self.locations
@@ -834,7 +1060,7 @@ class ArrayShadowGraph:
                 slot_of.pop(cell, None)
                 cells[slot] = None
             locations[slot] = None
-        self.free_slots.extend(slots_list)
+        self.free_slots.push_batch(garbage_slots)
         if self._node_log is not None:
             self._node_log.update(slots_list)
 
@@ -864,6 +1090,63 @@ class ArrayShadowGraph:
     @property
     def num_in_use(self) -> int:
         return len(self.slot_of)
+
+    def addresses_in_graph(self) -> Dict[str, int]:
+        """Uncollected shadows per node address
+        (reference: ShadowGraph.java:331-340, structured instead of
+        printed)."""
+        counts: Dict[str, int] = {}
+        for slot in self.slot_of.values():
+            loc = self.locations[slot]
+            counts[loc] = counts.get(loc, 0) + 1
+        return counts
+
+    def investigate_live_set(self) -> Dict[str, object]:
+        """Structured dump of the live set, vectorized over the slot
+        arrays (reference: ShadowGraph.java:342-394; same fields as the
+        oracle's implementation, differentially tested)."""
+        from .shadow import _cell_path
+
+        slots = np.fromiter(
+            self.slot_of.values(), np.int64, len(self.slot_of)
+        )
+        f = self.flags[slots]
+        local = (f & _F.FLAG_LOCAL) != 0
+        root_slots = slots[(f & _F.FLAG_ROOT) != 0]
+
+        # an edge exists iff weight != 0 (negative = more deactivations
+        # seen than creations so far), matching the oracle's outgoing map
+        eids = np.nonzero(self.edge_weight != 0)[0]
+        esrc = self.edge_src[eids]
+        edst = self.edge_dst[eids]
+        ew = self.edge_weight[eids]
+        out_degree = np.bincount(esrc, minlength=self.capacity)
+        local_all = (self.flags & _F.FLAG_LOCAL) != 0
+        src_local = local_all[esrc]
+        dst_local = local_all[edst]
+        ltr = np.nonzero(src_local & ~dst_local)[0]
+        local_to_remote = sorted(
+            (
+                _cell_path(self.cells[int(esrc[e])]),
+                _cell_path(self.cells[int(edst[e])]),
+                int(ew[e]),
+            )
+            for e in ltr.tolist()
+        )
+        return {
+            "total": int(slots.size),
+            "non_interned": int((~((f & _F.FLAG_INTERNED) != 0)).sum()),
+            "roots": int(root_slots.size),
+            "busy": int(((f & _F.FLAG_BUSY) != 0).sum()),
+            "nonzero_recv": int((self.recv_count[slots] != 0).sum()),
+            "nonlocal": int((~local).sum()),
+            "root_acquaintances": {
+                _cell_path(self.cells[int(s)]): int(out_degree[int(s)])
+                for s in root_slots.tolist()
+            },
+            "local_to_remote": local_to_remote,
+            "remote_to_local_count": int((~src_local & dst_local).sum()),
+        }
 
     def count_reachable_from(self, address: str) -> int:
         """(reference: ShadowGraph.java:302-330)"""

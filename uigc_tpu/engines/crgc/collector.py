@@ -300,6 +300,16 @@ class Bookkeeper(RawBehavior):
         count = 0
         multi = self.multi_node
         with events.recorder.timed(events.PROCESSING_ENTRIES) as ev:
+            # Packed plane first: its rows happened-before any object
+            # entries still in the queue for the same actors (the only
+            # object entries in packed mode are dead-letter accounting,
+            # which follows the dead actor's packed final flush).
+            plane = engine.packed_plane
+            if plane is not None:
+                rows = plane.drain()
+                if rows is not None:
+                    count += rows.shape[0]
+                    self.shadow_graph.merge_packed(rows)
             batch = []
             while True:
                 try:
@@ -347,6 +357,23 @@ class Bookkeeper(RawBehavior):
         else:
             graph.trace(should_kill=True)
         return count
+
+    def diagnostic_dump(self) -> Dict[str, Any]:
+        """Structured collector diagnostics (the reference's println
+        inspectors, ShadowGraph.java:331-394, as data): per-address
+        shadow counts and the live-set breakdown.  Backends without the
+        inspectors (e.g. native) report what they have."""
+        g = self.shadow_graph
+        out: Dict[str, Any] = {
+            "total_entries": self.total_entries,
+            "members": sorted(self.remote_gcs),
+            "downed": sorted(self.downed_gcs),
+        }
+        if hasattr(g, "addresses_in_graph"):
+            out["addresses_in_graph"] = g.addresses_in_graph()
+        if hasattr(g, "investigate_live_set"):
+            out["live_set"] = g.investigate_live_set()
+        return out
 
     def finalize_delta_graph(self) -> None:
         """(reference: LocalGC.scala:191-196)"""

@@ -62,11 +62,27 @@ class CRGC(Engine):
         # reference gets from ConcurrentLinkedQueue (CRGC.scala:18,52).
         self.queue: deque = deque()
         self.entry_pool: deque = deque()
+        self.packed_plane = None
 
         self.bookkeeper = self.make_bookkeeper()
         self.bookkeeper_cell = system.spawn_system_raw(
             self.bookkeeper, "Bookkeeper", pinned=True
         )
+
+        # Packed entry plane (packed.py): the single-node hot path.
+        # Gated off when a fabric is attached — the multi-node fold
+        # additionally builds delta graphs from object entries — and for
+        # backends without the array fold (the oracle, the native graph).
+        graph = self.bookkeeper.shadow_graph
+        if (
+            config.get_bool("uigc.crgc.packed-entries")
+            and system.fabric is None
+            and hasattr(graph, "merge_packed")
+        ):
+            from .packed import PackedPlane
+
+            self.packed_plane = PackedPlane(self.crgc_context.entry_field_size)
+            graph.attach_packed_plane(self.packed_plane, system.resolve_cell)
 
     # Factory hooks so the multi-node engine can substitute richer parts.
 
@@ -251,6 +267,12 @@ class CRGC(Engine):
 
     def send_entry(self, state: CrgcState, is_busy: bool) -> None:
         """(reference: CRGC.scala:179-193)"""
+        plane = self.packed_plane
+        if plane is not None:
+            state.flush_to_ring(is_busy, plane)
+            if events.recorder.enabled:
+                events.recorder.commit(events.ENTRY_SEND, allocated_memory=False)
+            return
         entry = self._obtain_entry()
         state.flush_to_entry(is_busy, entry)
         self.queue.append(entry)

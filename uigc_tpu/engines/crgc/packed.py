@@ -1,0 +1,150 @@
+"""Packed mutator->collector entry plane.
+
+The object ``Entry`` snapshot (state.py, reference: crgc/Entry.java:5-37)
+is the differential oracle's plane and the multi-node plane (delta graphs
+need refob identity).  This module is the single-node hot path the SURVEY
+§7 design calls for: a flush writes one packed int64 row into a
+per-thread ring buffer, and the collector's drain is array slicing — no
+per-entry Python object walk anywhere on the Bookkeeper thread (the
+system's single fold bottleneck; the mutator threads, which scale with
+the dispatcher pool, pay the flattening instead).
+
+Row layout (width = 4 + 5*E, E = entry-field-size, -1 = empty field):
+
+    col 0          seq       global flush order (busy/root bits are
+                             last-writer-wins per actor, so cross-thread
+                             total order must be restorable at the fold)
+    col 1          self uid  ``ActorCell.uid`` (dense per system)
+    col 2          bits      bit0 busy, bit1 root
+    col 3          recv      messages received this period
+    cols 4..4+2E   E created (owner_uid, target_uid) pairs
+    next E         E spawned child uids
+    next 2E        E updated (target_uid, packed refob info) pairs
+
+Uids, not slots: slot assignment stays single-writer on the collector
+(ArrayShadowGraph.merge_packed maps uids through a dense ``uid -> slot``
+array and interns only unseen uids).  The plane's ``uid_strong`` dict
+pins every cell named by an in-flight row so the collector can always
+resolve it; the collector unpins at intern time.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+ROW_FIXED = 4  # seq, self uid, busy/root bits, recv count
+
+
+def row_width(entry_field_size: int) -> int:
+    return ROW_FIXED + 5 * entry_field_size
+
+
+class PackedRing:
+    """SPSC ring of packed rows: one writer (the mutator thread that owns
+    it), one reader (the Bookkeeper).  The writer's fast path takes no
+    lock — under the GIL the row store completes before the ``w``
+    publish, and the reader never reads at or past ``w``.  The lock
+    serializes only the two buffer-wide operations: writer grow and
+    reader drain."""
+
+    __slots__ = ("buf", "cap", "r", "w", "lock")
+
+    def __init__(self, width: int, cap: int = 1 << 12):
+        assert cap & (cap - 1) == 0
+        self.buf = np.empty((cap, width), dtype=np.int64)
+        self.cap = cap
+        self.r = 0  # read cursor (reader-owned), monotonic
+        self.w = 0  # write cursor (writer-owned), monotonic
+        self.lock = threading.Lock()
+
+    def begin(self) -> np.ndarray:
+        """The next row's buffer view; the reader cannot see it until
+        :meth:`commit`.  Stale contents from a previous lap — the caller
+        must fill every column."""
+        if self.w - self.r >= self.cap:
+            # A stale ``r`` read only over-estimates fullness (r is
+            # monotonic), so a spurious grow is possible but an
+            # overwrite of unread rows is not.
+            with self.lock:
+                self._grow()
+        return self.buf[self.w & (self.cap - 1)]
+
+    def commit(self) -> None:
+        self.w += 1
+
+    def _grow(self) -> None:
+        # Reader excluded by the lock; relinearize [r, w) from 0.
+        cap, r, w = self.cap, self.r, self.w
+        new = np.empty((cap * 2, self.buf.shape[1]), dtype=np.int64)
+        idx = (np.arange(r, w) & (cap - 1))
+        count = w - r
+        new[:count] = self.buf[idx]
+        self.buf = new
+        self.cap = cap * 2
+        self.r = 0
+        self.w = count
+
+    def drain(self) -> Optional[np.ndarray]:
+        """Copy out all committed rows (None if empty)."""
+        with self.lock:
+            r, w = self.r, self.w
+            if r == w:
+                return None
+            cap = self.cap
+            i0 = r & (cap - 1)
+            i1 = w & (cap - 1)
+            if i0 < i1:
+                out = self.buf[i0:i1].copy()
+            else:  # wrapped (or exactly full)
+                out = np.concatenate([self.buf[i0:], self.buf[:i1]])
+            self.r = w
+            return out
+
+
+class PackedPlane:
+    """Per-engine bundle: one ring per mutator thread, the global flush
+    sequence, and the strong uid->cell pin set."""
+
+    def __init__(self, entry_field_size: int):
+        self.entry_field_size = entry_field_size
+        self.width = row_width(entry_field_size)
+        #: itertools.count.__next__ is a single C call — atomic under
+        #: the GIL, so concurrent flushes get distinct ordered stamps.
+        self._seq = itertools.count()
+        #: cells named by in-flight rows; dict.setdefault / .pop are
+        #: individually atomic under the GIL.  The collector pops a uid
+        #: once interned (the graph's own cells[] pins it from there).
+        self.uid_strong: Dict[int, object] = {}
+        self._rings: Dict[int, PackedRing] = {}
+        self._lock = threading.Lock()
+        self._tl = threading.local()
+
+    def next_seq(self) -> int:
+        return next(self._seq)
+
+    def ring(self) -> PackedRing:
+        r = getattr(self._tl, "ring", None)
+        if r is None:
+            r = PackedRing(self.width)
+            with self._lock:
+                # Keyed by ring identity, not thread id: thread-id reuse
+                # after a worker dies must not alias two rings.  A dead
+                # thread's drained-empty ring is a small, bounded leak
+                # (the dispatcher pool is fixed-size).
+                self._rings[id(r)] = r
+            self._tl.ring = r
+        return r
+
+    def drain(self) -> Optional[np.ndarray]:
+        """All committed rows from every ring, unsorted (merge_packed
+        restores flush order from the seq column)."""
+        with self._lock:
+            rings = list(self._rings.values())
+        parts = [p for p in (r.drain() for r in rings) if p is not None]
+        if not parts:
+            return None
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)

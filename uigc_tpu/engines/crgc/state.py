@@ -176,3 +176,54 @@ class CrgcState(StateBase):
             refob.reset()
             self.updated_refobs[i] = None
         self.updated_idx = 0
+
+    def flush_to_ring(self, is_busy: bool, plane) -> None:
+        """Move-and-clear flush into the packed plane (packed.py row
+        layout) instead of an object Entry — same facts, same reset
+        semantics as :meth:`flush_to_entry`, but the collector-side fold
+        becomes pure array work.  Every cell named by the row is pinned
+        in ``plane.uid_strong`` *before* the commit publishes the row,
+        so the collector can always resolve the uid."""
+        ring = plane.ring()
+        us = plane.uid_strong
+        v = ring.begin()
+        sc = self.self_ref._target
+        v[0] = plane.next_seq()
+        v[1] = sc.uid
+        us.setdefault(sc.uid, sc)
+        v[2] = (1 if is_busy else 0) | (2 if self.is_root else 0)
+        v[3] = self.recv_count
+        self.recv_count = 0
+        v[4:] = -1
+
+        base = 4
+        for i in range(self.created_idx):
+            oc = self.created_owners[i]._target
+            tc = self.created_targets[i]._target
+            us.setdefault(oc.uid, oc)
+            us.setdefault(tc.uid, tc)
+            v[base + 2 * i] = oc.uid
+            v[base + 2 * i + 1] = tc.uid
+            self.created_owners[i] = None
+            self.created_targets[i] = None
+        self.created_idx = 0
+
+        base += 2 * self.context.entry_field_size
+        for i in range(self.spawned_idx):
+            cc = self.spawned_actors[i]._target
+            us.setdefault(cc.uid, cc)
+            v[base + i] = cc.uid
+            self.spawned_actors[i] = None
+        self.spawned_idx = 0
+
+        base += self.context.entry_field_size
+        for i in range(self.updated_idx):
+            refob = self.updated_refobs[i]
+            tc = refob._target
+            us.setdefault(tc.uid, tc)
+            v[base + 2 * i] = tc.uid
+            v[base + 2 * i + 1] = refob.info
+            refob.reset()
+            self.updated_refobs[i] = None
+        self.updated_idx = 0
+        ring.commit()
