@@ -1,0 +1,13 @@
+"""Thin child-process runner: keeps role code importable as
+``nodeproc_common`` in every process (see that module's note)."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import nodeproc_common
+
+if __name__ == "__main__":
+    nodeproc_common.run_child(json.loads(sys.argv[1]))

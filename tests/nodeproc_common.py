@@ -1,0 +1,224 @@
+"""Shared roles for the multi-process cluster tests.
+
+Imported under the SAME module name by the pytest driver process and by
+every child process (via nodeproc_child.py), so pickled application
+messages resolve to identical classes on both sides of the socket."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from uigc_tpu import AbstractBehavior, Behaviors, Message, NoRefs, PostStop
+from uigc_tpu.runtime.behaviors import RawBehavior
+from uigc_tpu.runtime.node import NodeFabric
+from uigc_tpu.runtime.system import ActorSystem
+
+BASE = {
+    "uigc.crgc.wakeup-interval": 10,
+    "uigc.crgc.egress-finalize-interval": 5,
+    "uigc.crgc.num-nodes": 3,
+}
+
+
+class Ping(NoRefs):
+    pass
+
+
+class Share(Message):
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,) if self.ref is not None else ()
+
+
+class DropCmd(NoRefs):
+    pass
+
+
+class Spawned(NoRefs):
+    def __init__(self, name):
+        self.name = name
+
+
+class Stopped(NoRefs):
+    def __init__(self, name):
+        self.name = name
+
+
+class RemoteProbe:
+    """Probe facade whose .ref is a ProxyCell of the driver's probe
+    forwarder cell."""
+
+    def __init__(self, cell):
+        self.ref = cell
+
+
+class Worker(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        probe.ref.tell(Spawned(context.name))
+
+    def on_message(self, msg):
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(Stopped(self.context.name))
+        return None
+
+
+class Holder(AbstractBehavior):
+    """Root on the doomed node, holding the only ref to a remote
+    worker."""
+
+    def __init__(self, context):
+        super().__init__(context)
+        self.held = None
+
+    def on_message(self, msg):
+        if isinstance(msg, Share):
+            self.held = msg.ref
+            self.held.tell(Ping(), self.context)
+        return self
+
+
+class Owner(AbstractBehavior):
+    """Root on node B owning the worker; hands a ref to the doomed
+    node's holder, then releases its own."""
+
+    def __init__(self, context, probe, holder_ref):
+        super().__init__(context)
+        self.worker = context.spawn(
+            Behaviors.setup(lambda ctx: Worker(ctx, probe)), "worker"
+        )
+        self.holder_ref = holder_ref
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, Share):
+            self.holder_ref.tell(
+                Share(ctx.create_ref(self.worker, self.holder_ref)), ctx
+            )
+        elif isinstance(msg, DropCmd):
+            ctx.release(self.worker)
+        return self
+
+
+class ProbeForwarder(RawBehavior):
+    """Unmanaged cell on the driver node that funnels raw cross-process
+    messages into the in-process TestProbe."""
+
+    def __init__(self, probe):
+        self.probe = probe
+
+    def on_message(self, msg):
+        self.probe._offer(msg)
+        return None
+
+
+def _say(line: str) -> None:
+    sys.stdout.write(line + "\n")
+    sys.stdout.flush()
+
+
+def run_child(spec: dict) -> None:
+    """Child process main: build the node, listen, then follow stdin
+    commands from the driver."""
+    role = spec["role"]
+    address = spec["address"]
+    with_drops = spec.get("with_drops", False)
+    backend = spec.get("backend", "array")
+
+    config = dict(BASE)
+    config["uigc.crgc.shadow-graph"] = backend
+
+    fabric = NodeFabric()
+    system = ActorSystem(None, name=address, config=config, fabric=fabric)
+
+    holder_handle = None
+    owner_handle = None
+    if role == "holder":
+        holder_handle = system.spawn_root(
+            Behaviors.setup_root(lambda ctx: Holder(ctx)), "holder"
+        )
+        fabric.register_name("holder", holder_handle.cell)
+
+    port = fabric.listen()
+    _say(f"READY {port}")
+
+    for raw in sys.stdin:
+        parts = raw.strip().split()
+        if not parts:
+            continue
+        cmd = parts[0]
+        if cmd == "connect":
+            host, p = parts[1].rsplit(":", 1)
+            peer = fabric.connect(host, int(p))
+            _say(f"CONNECTED {peer}")
+        elif cmd == "spawn_owner":
+            holder_addr = f"uigc://{parts[1]}"
+            probe_addr = f"uigc://{parts[2]}"
+            # wait for both peers' hellos (names arrive with them)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    holder = fabric.lookup(holder_addr, "holder")
+                    probe_cell = fabric.lookup(probe_addr, "probe")
+                    break
+                except KeyError:
+                    time.sleep(0.05)
+            else:
+                _say("ERROR lookup timed out")
+                continue
+            if with_drops:
+                fabric.set_inbound_drop_filter(
+                    holder_addr,
+                    lambda m: isinstance(getattr(m, "payload", None), Ping),
+                )
+            probe = RemoteProbe(probe_cell)
+
+            def make_owner(ctx):
+                return Owner(ctx, probe, ctx.engine.to_root_refob(holder))
+
+            owner_handle = system.spawn_root(
+                Behaviors.setup_root(make_owner), "owner"
+            )
+            _say("OWNER_SPAWNED")
+        elif cmd == "share":
+            owner_handle.tell(Share(None))
+            _say("SHARED")
+        elif cmd == "drop":
+            owner_handle.tell(DropCmd())
+            _say("DROPPED")
+        elif cmd == "dump":
+            bk = system.engine.bookkeeper
+            state = {
+                "members": fabric.members(),
+                "crashed": sorted(fabric.crashed),
+                "remote_gcs": sorted(bk.remote_gcs),
+                "downed": sorted(bk.downed_gcs),
+                "undone": sorted(bk.undone_gcs),
+                "finalized_by": {
+                    a: sorted(l.finalized_by) for a, l in bk.undo_logs.items()
+                },
+                "in_use": getattr(bk.shadow_graph, "num_in_use", -1),
+            }
+            _say("DUMP " + json.dumps(state))
+        elif cmd == "exit":
+            break
+    import os
+
+    os._exit(0)
+
+# NOTE: no __main__ entry here on purpose — children must run via
+# nodeproc_child.py so this module keeps the name "nodeproc_common" in
+# every process (pickled message classes must resolve identically).

@@ -1,0 +1,144 @@
+"""Multi-process cluster: collectors in separate OS processes over real
+TCP sockets (runtime/node.py), with ``kill -9`` as the crash injection.
+
+The cross-process port of ``test_three_node_crash_recovery``: a worker
+on node B (child process) is kept alive solely by a ref held on node C
+(another child process).  C is SIGKILLed; the survivors see the socket
+die, finalize the dead links, reach the undo-log quorum over the
+network, and the worker is collected — observed by the driver process
+(node A) through its probe.  This is the failure mode the in-process
+fabric cannot produce: a peer that vanishes mid-protocol with no
+opportunity to flush anything beyond what the kernel already accepted.
+
+Reference: reference.conf:2-10 (real Artery transport),
+LocalGC.scala:201 (cross-network collector gossip), 228-243 (member
+removal recovery).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from queue import Empty, Queue
+
+import pytest
+
+from nodeproc_common import BASE, ProbeForwarder, Spawned, Stopped
+
+from uigc_tpu.runtime.node import NodeFabric
+from uigc_tpu.runtime.system import ActorSystem
+from uigc_tpu.runtime.testkit import TestProbe
+
+CHILD = Path(__file__).resolve().parent / "nodeproc_child.py"
+
+
+class Child:
+    """A node child process with line-based stdin/stdout control."""
+
+    def __init__(self, spec: dict):
+        self.proc = subprocess.Popen(
+            [sys.executable, str(CHILD), json.dumps(spec)],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            bufsize=1,
+        )
+        self._lines: Queue = Queue()
+        threading.Thread(target=self._pump, daemon=True).start()
+        self.port = int(self.expect("READY").split()[1])
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            self._lines.put(line.strip())
+
+    def send(self, cmd: str) -> None:
+        self.proc.stdin.write(cmd + "\n")
+        self.proc.stdin.flush()
+
+    def expect(self, prefix: str, timeout: float = 30.0) -> str:
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise AssertionError(
+                    f"child did not print {prefix!r} in time; stderr:\n"
+                    + (self.proc.stderr.read() if self.proc.poll() is not None else "")
+                )
+            try:
+                line = self._lines.get(timeout=remaining)
+            except Empty:
+                continue
+            if line.startswith(prefix):
+                return line
+            if line.startswith("ERROR"):
+                raise AssertionError(f"child error: {line}")
+
+    def kill9(self) -> None:
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait(timeout=10)
+
+    def shutdown(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.send("exit")
+                self.proc.wait(timeout=5)
+            except Exception:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+
+
+@pytest.mark.parametrize("with_drops", [False, True], ids=["clean", "drops"])
+def test_multiprocess_three_node_crash_recovery(with_drops):
+    config = dict(BASE)
+    config["uigc.crgc.shadow-graph"] = "array"
+
+    fabric = NodeFabric()
+    system = ActorSystem(None, name="procA", config=config, fabric=fabric)
+    child_b = child_c = None
+    try:
+        probe = TestProbe(default_timeout_s=30.0)
+        probe_cell = system.spawn_system_raw(ProbeForwarder(probe), "probe-fwd")
+        fabric.register_name("probe", probe_cell)
+        fabric.listen()
+
+        child_c = Child({"role": "holder", "address": "procC"})
+        child_b = Child(
+            {"role": "owner", "address": "procB", "with_drops": with_drops}
+        )
+
+        # full mesh: A dials both children; B dials C
+        fabric.connect("127.0.0.1", child_b.port)
+        fabric.connect("127.0.0.1", child_c.port)
+        child_b.send(f"connect 127.0.0.1:{child_c.port}")
+        child_b.expect("CONNECTED")
+
+        child_b.send("spawn_owner procC procA")
+        child_b.expect("OWNER_SPAWNED")
+        spawned = probe.expect_message_type(Spawned)
+
+        child_b.send("share")  # hand the only surviving ref to C's holder
+        child_b.expect("SHARED")
+        time.sleep(0.5)
+        child_b.send("drop")  # B releases; only C's ref keeps the worker
+        child_b.expect("DROPPED")
+        probe.expect_no_message(0.5)
+
+        # C vanishes mid-protocol.  Survivors detect the dead socket,
+        # finalize the dead links, reach quorum, fold the undo log, and
+        # the worker on B finally collapses.
+        child_c.kill9()
+        stopped = probe.expect_message_type(Stopped)
+        assert stopped.name == spawned.name
+    finally:
+        if child_b is not None:
+            child_b.shutdown()
+        if child_c is not None:
+            child_c.shutdown()
+        system.terminate()

@@ -1,0 +1,542 @@
+"""Cross-process node transport: one ActorSystem per OS process, linked
+over TCP sockets.
+
+The in-process ``Fabric`` (fabric.py) models the reference's cluster as
+thread groups sharing one interpreter; this module is the real process
+boundary — the analogue of the reference's Artery-over-TCP remoting
+(reference: reference.conf:2-10 registers the remoting stages;
+LocalGC.scala:201 gossips collector state across the network).  Each
+process hosts exactly one system plus a ``NodeFabric``; peers are reached
+through length-prefixed frames on one TCP connection per node pair, and
+every cross-boundary object is re-materialized from wire tokens — object
+identity cannot survive, because there is no shared heap to leak it
+through.
+
+What maps where:
+
+- app messages:   egress stamp -> wire bytes -> TCP -> ingress tally ->
+                  local mailbox (per-link FIFO = TCP order)
+- window markers: ``finalize_egress`` sends the marker id in-stream; the
+                  receiving ingress closes the matching window
+                  (reference: Gateways.scala:83-94,168-171)
+- collector gossip: delta graphs and ingress-entry rebroadcasts cross in
+                  their own wire formats (DeltaGraph.java:189-232,
+                  IngressEntry.java:103-144)
+- membership:     a peer's connection dying (e.g. ``kill -9``) is the
+                  failure detector — EOF marks the member removed, and
+                  everything the dead node sent before dying was already
+                  delivered in order (TCP flushes the kernel buffer),
+                  matching the reference's drain-then-finalize semantics
+- remote cells:   ``ProxyCell`` stands in for a cell of another process:
+                  same (address, uid) token the wire codec uses, cached
+                  per fabric so one remote actor folds to one shadow slot
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
+
+from . import wire
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .cell import ActorCell
+    from .system import ActorSystem
+
+from .fabric import MemberRemoved, MemberUp
+
+
+class ProxySystem:
+    """Address-only stand-in for a remote process's system (enough for
+    `target.system is not self.system` routing and address reads)."""
+
+    __slots__ = ("address",)
+
+    def __init__(self, address: str):
+        self.address = address
+
+
+class ProxyCell:
+    """Local handle for a cell living in another process.  Hash/eq by
+    (address, uid) so re-decoded handles fold to the same shadow slot;
+    the fabric additionally caches instances for identity stability."""
+
+    __slots__ = ("system", "uid", "path", "_fabric")
+
+    def __init__(self, fabric: "NodeFabric", address: str, uid: int, path: str = ""):
+        self.system = ProxySystem(address)
+        self.uid = uid
+        self.path = path or f"remote://{address}/{uid}"
+        self._fabric = fabric
+
+    def tell(self, msg: Any) -> None:
+        self._fabric.deliver(self._fabric.system, self, msg)
+
+    def __hash__(self) -> int:
+        return hash((self.system.address, self.uid))
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, ProxyCell)
+            and other.uid == self.uid
+            and other.system.address == self.system.address
+        )
+
+    def __repr__(self) -> str:
+        return f"ProxyCell({self.system.address}, uid={self.uid})"
+
+
+class _StubEngine:
+    __slots__ = ("bookkeeper_cell",)
+
+    def __init__(self, bookkeeper_cell: ProxyCell):
+        self.bookkeeper_cell = bookkeeper_cell
+
+
+class RemoteSystemStub:
+    """What ``fabric.systems[peer]`` yields for a connected peer: just
+    enough surface for the collector's membership path
+    (``peer_system.engine.bookkeeper_cell``, ``fabric.link(...)``)."""
+
+    __slots__ = ("address", "engine")
+
+    def __init__(self, address: str, bookkeeper_cell: ProxyCell):
+        self.address = address
+        self.engine = _StubEngine(bookkeeper_cell)
+
+
+class _HalfLink:
+    """One direction of a node pair as seen from this process: the
+    outbound half owns the egress, the inbound half owns the ingress
+    (the other half lives in the peer process)."""
+
+    __slots__ = ("src", "dst", "egress", "ingress", "send_lock", "recv_lock", "drop_filter")
+
+    def __init__(self, src, dst):
+        self.src = src
+        self.dst = dst
+        self.egress = None
+        self.ingress = None
+        self.send_lock = threading.Lock()
+        self.recv_lock = threading.Lock()
+        self.drop_filter: Optional[Callable[[Any], bool]] = None
+
+
+class _Conn:
+    __slots__ = ("sock", "lock", "address")
+
+    def __init__(self, sock: socket.socket, address: str = ""):
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.address = address
+
+    def send(self, frame: tuple) -> None:
+        buf = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+        with self.lock:
+            self.sock.sendall(struct.pack(">I", len(buf)) + buf)
+
+    def recv(self) -> Optional[tuple]:
+        header = self._read_exact(4)
+        if header is None:
+            return None
+        (n,) = struct.unpack(">I", header)
+        body = self._read_exact(n)
+        if body is None:
+            return None
+        return pickle.loads(body)
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        chunks = []
+        while n:
+            try:
+                chunk = self.sock.recv(n)
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class NodeFabric:
+    """Fabric implementation for one process of a multi-process cluster.
+
+    Create it, build the ActorSystem against it, optionally
+    ``register_name`` well-known cells, then ``listen()`` and
+    ``connect()`` to peers.  Serialization is not optional here — there
+    is no object path across a process boundary."""
+
+    serialize = True  # read by engines that branch on the fabric mode
+
+    def __init__(self, address: str = ""):
+        #: canonical cluster address — MUST equal the hosted system's
+        #: address (undo-log quorums compare ingress-entry addresses
+        #: against membership addresses; one namespace, or quorums never
+        #: match).  Normally left empty and adopted at register_system.
+        self.address = address
+        self.system: Optional["ActorSystem"] = None
+        self.systems: Dict[str, Any] = {}
+        self.crashed: set = set()
+        self._subscribers: List["ActorCell"] = []
+        self._lock = threading.Lock()
+        self._names: Dict[str, Any] = {}
+        self._peer_names: Dict[str, Dict[str, int]] = {}
+        self._conns: Dict[str, _Conn] = {}
+        self._proxies: Dict[Tuple[str, int], ProxyCell] = {}
+        self._out: Dict[str, _HalfLink] = {}
+        self._in: Dict[str, _HalfLink] = {}
+        self._listener: Optional[socket.socket] = None
+        self._closing = False
+
+    # ------------------------------------------------------------- #
+    # System + name registry
+    # ------------------------------------------------------------- #
+
+    def register_system(self, system: "ActorSystem") -> None:
+        assert self.system is None, "one system per NodeFabric (one per process)"
+        assert not self.address or self.address == system.address, (
+            f"fabric address {self.address!r} != system address "
+            f"{system.address!r} — quorum bookkeeping needs one namespace"
+        )
+        self.system = system
+        self.address = system.address
+        self.systems[system.address] = system
+
+    def unregister_system(self, system: "ActorSystem") -> None:
+        self.close()
+
+    def register_name(self, name: str, cell: Any) -> None:
+        """Advertise a well-known local cell (exchanged in the hello
+        frame, the analogue of an actor selection path)."""
+        self._names[name] = cell
+
+    def lookup(self, address: str, name: str) -> ProxyCell:
+        uid = self._peer_names[address][name]
+        return self._proxy(address, uid)
+
+    def _proxy(self, address: str, uid: int) -> ProxyCell:
+        key = (address, uid)
+        p = self._proxies.get(key)
+        if p is None:
+            p = self._proxies[key] = ProxyCell(self, address, uid)
+        return p
+
+    def resolve_cell_token(self, address: str, uid: int):
+        """wire.py resolution hook: local uids resolve to real cells,
+        remote uids to cached proxies."""
+        if address == self.address:
+            cell = self.system.resolve_cell(uid)
+            if cell is None:
+                raise LookupError(f"no cell uid={uid} in {address!r}")
+            return cell
+        return self._proxy(address, uid)
+
+    # ------------------------------------------------------------- #
+    # Wire-up
+    # ------------------------------------------------------------- #
+
+    def _hello(self) -> tuple:
+        bk = self.system.engine.bookkeeper_cell
+        names = {n: c.uid for n, c in self._names.items()}
+        return ("hello", self.address, names, bk.uid)
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start accepting peer connections; returns the bound port."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(8)
+        self._listener = srv
+        threading.Thread(
+            target=self._accept_loop, name="node-accept", daemon=True
+        ).start()
+        return srv.getsockname()[1]
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn,
+                args=(_Conn(sock),),
+                name="node-conn",
+                daemon=True,
+            ).start()
+
+    def connect(self, host: str, port: int) -> str:
+        """Dial a peer; blocks until its hello arrives.  Returns the
+        peer's address."""
+        sock = socket.create_connection((host, port), timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock)
+        conn.send(self._hello())
+        hello = conn.recv()
+        if hello is None or hello[0] != "hello":
+            raise ConnectionError("peer handshake failed")
+        self._install_peer(conn, hello)
+        threading.Thread(
+            target=self._recv_loop, args=(conn,), name="node-conn", daemon=True
+        ).start()
+        return conn.address
+
+    def _serve_conn(self, conn: _Conn) -> None:
+        hello = conn.recv()
+        if hello is None or hello[0] != "hello":
+            conn.close()
+            return
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.send(self._hello())
+        self._install_peer(conn, hello)
+        self._recv_loop(conn)
+
+    def _install_peer(self, conn: _Conn, hello: tuple) -> None:
+        _, address, names, bk_uid = hello
+        conn.address = address
+        with self._lock:
+            self._conns[address] = conn
+            self._peer_names[address] = names
+            self.systems[address] = RemoteSystemStub(
+                address, self._proxy(address, bk_uid)
+            )
+            subscribers = list(self._subscribers)
+        for s in subscribers:
+            s.tell(MemberUp(address))
+
+    def _recv_loop(self, conn: _Conn) -> None:
+        while True:
+            frame = conn.recv()
+            if frame is None:
+                break
+            try:
+                self._on_frame(conn.address, frame)
+            except Exception:  # pragma: no cover - keep the link alive
+                import traceback
+
+                traceback.print_exc()
+        self._on_disconnect(conn.address)
+
+    def _on_disconnect(self, address: str) -> None:
+        """EOF from a peer = the member died (or left): kill -9 of the
+        peer process lands here, after everything it managed to send was
+        delivered in order."""
+        if self._closing or not address:
+            return
+        with self._lock:
+            if address in self.crashed or address not in self._conns:
+                return
+            self.crashed.add(address)
+            subscribers = list(self._subscribers)
+        for s in subscribers:
+            s.tell(MemberRemoved(address))
+
+    # ------------------------------------------------------------- #
+    # Membership surface (collector-facing)
+    # ------------------------------------------------------------- #
+
+    def subscribe(self, cell: "ActorCell") -> None:
+        with self._lock:
+            self._subscribers.append(cell)
+            current = [self.address] + [
+                a for a in self._conns if a not in self.crashed
+            ]
+        for address in current:
+            cell.tell(MemberUp(address))
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return [self.address] + [a for a in self._conns if a not in self.crashed]
+
+    # ------------------------------------------------------------- #
+    # Links
+    # ------------------------------------------------------------- #
+
+    def link(self, src: Any, dst: Any) -> _HalfLink:
+        """The collector's eager link establishment: outbound halves get
+        the local egress, inbound halves the local ingress."""
+        if src is self.system:
+            return self._out_link(dst.address)
+        return self._in_link(src.address)
+
+    def _out_link(self, dst_address: str) -> _HalfLink:
+        with self._lock:
+            l = self._out.get(dst_address)
+            if l is None:
+                l = _HalfLink(self.system, self.systems.get(dst_address))
+                l.egress = self.system.engine.spawn_egress(
+                    _LinkFacade(self.system, ProxySystem(dst_address))
+                )
+                self._out[dst_address] = l
+            return l
+
+    def _in_link(self, src_address: str) -> _HalfLink:
+        with self._lock:
+            l = self._in.get(src_address)
+            if l is None:
+                l = _HalfLink(self.systems.get(src_address), self.system)
+                l.ingress = self.system.engine.spawn_ingress(
+                    _LinkFacade(ProxySystem(src_address), self.system)
+                )
+                self._in[src_address] = l
+            return l
+
+    def set_inbound_drop_filter(
+        self, src_address: str, fn: Optional[Callable[[Any], bool]]
+    ) -> None:
+        """Fault injection at the receiving edge: fn(msg) -> True drops
+        the message after decode, before the ingress tally (the same
+        observable semantics as the in-process fabric's drop filter —
+        the bytes 'arrived' but were never admitted)."""
+        self._in_link(src_address).drop_filter = fn
+
+    # ------------------------------------------------------------- #
+    # Delivery
+    # ------------------------------------------------------------- #
+
+    def _conn_for(self, address: str) -> Optional[_Conn]:
+        with self._lock:
+            if address in self.crashed:
+                return None
+            return self._conns.get(address)
+
+    def deliver(self, src: "ActorSystem", target: ProxyCell, msg: Any) -> None:
+        dst_address = target.system.address
+        conn = self._conn_for(dst_address)
+        if conn is None:
+            return
+        link = self._out_link(dst_address)
+        with link.send_lock:
+            if link.egress is not None:
+                link.egress.on_message(target, msg)
+            payload = wire.encode_message(msg)
+            try:
+                conn.send(("app", target.uid, payload))
+            except OSError:
+                self._on_disconnect(dst_address)
+
+    def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
+        conn = self._conn_for(dst_address)
+        if conn is None:
+            return
+        link = self._out_link(dst_address)
+        with link.send_lock:
+            if link.egress is None:
+                return
+            marker = link.egress.finalize_entry()
+            try:
+                conn.send(("marker", marker.id))
+            except OSError:
+                self._on_disconnect(dst_address)
+
+    def finalize_dead_link(self, src_address: str, dst: "ActorSystem") -> None:
+        with self._lock:
+            link = self._in.get(src_address)
+        if link is None or link.ingress is None:
+            return
+        with link.recv_lock:
+            link.ingress.finalize_all(is_final=True)
+
+    def control_send(self, src: "ActorSystem", target_cell: Any, msg: Any) -> None:
+        """Collector gossip: reliable, typed wire formats
+        (reference: LocalGC.scala:201)."""
+        from ..engines.crgc.collector import DeltaMsg, RemoteIngressEntry
+
+        dst_address = target_cell.system.address
+        if dst_address == self.address:
+            target_cell.tell(msg)
+            return
+        conn = self._conn_for(dst_address)
+        if conn is None:
+            return
+        try:
+            if isinstance(msg, DeltaMsg):
+                conn.send(
+                    ("delta", msg.seqnum, msg.graph.serialize(wire.encode_cell))
+                )
+            elif isinstance(msg, RemoteIngressEntry):
+                conn.send(("ringress", msg.entry.serialize(wire.encode_cell)))
+            else:
+                conn.send(("ctrl", wire.encode_message(msg)))
+        except OSError:
+            self._on_disconnect(dst_address)
+
+    # ------------------------------------------------------------- #
+    # Frame dispatch (receiver side)
+    # ------------------------------------------------------------- #
+
+    def _on_frame(self, from_address: str, frame: tuple) -> None:
+        kind = frame[0]
+        if kind == "app":
+            _, uid, payload = frame
+            cell = self.system.resolve_cell(uid)
+            msg = wire.decode_message(self, payload)
+            link = self._in_link(from_address)
+            if link.drop_filter is not None and link.drop_filter(msg):
+                return
+            if cell is None:
+                self.system.record_dead_letters_dropped(None, 1)
+                return
+            with link.recv_lock:
+                if link.ingress is not None:
+                    link.ingress.on_message(cell, msg)
+                cell.tell(msg)
+        elif kind == "marker":
+            link = self._in_link(from_address)
+            with link.recv_lock:
+                if link.ingress is not None:
+                    link.ingress.finalize_window(frame[1])
+        elif kind == "delta":
+            from ..engines.crgc.collector import DeltaMsg
+            from ..engines.crgc.delta import DeltaGraph
+
+            graph = DeltaGraph.deserialize(
+                frame[2],
+                self.system.engine.crgc_context,
+                wire.make_decode_cell(self),
+            )
+            self.system.engine.bookkeeper_cell.tell(DeltaMsg(frame[1], graph))
+        elif kind == "ringress":
+            from ..engines.crgc.collector import RemoteIngressEntry
+            from ..engines.crgc.gateways import IngressEntry
+
+            entry = IngressEntry.deserialize(frame[1], wire.make_decode_cell(self))
+            self.system.engine.bookkeeper_cell.tell(RemoteIngressEntry(entry))
+        elif kind == "ctrl":
+            self.system.engine.bookkeeper_cell.tell(
+                wire.decode_message(self, frame[1])
+            )
+
+    # ------------------------------------------------------------- #
+
+    def close(self) -> None:
+        self._closing = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            c.close()
+
+
+class _LinkFacade:
+    """The (src, dst) pair shape Egress/Ingress constructors read."""
+
+    __slots__ = ("src", "dst")
+
+    def __init__(self, src, dst):
+        self.src = src
+        self.dst = dst

@@ -41,6 +41,11 @@ def make_decode_cell(fabric: "Fabric"):
 
 
 def _resolve(fabric: "Fabric", address: str, uid: int):
+    # Cross-process fabrics (runtime/node.py) resolve remote tokens to
+    # proxy handles instead of reaching into another system's heap.
+    hook = getattr(fabric, "resolve_cell_token", None)
+    if hook is not None:
+        return hook(address, uid)
     system = fabric.systems.get(address)
     if system is None:
         raise LookupError(f"unknown system {address!r} on this fabric")
@@ -48,6 +53,20 @@ def _resolve(fabric: "Fabric", address: str, uid: int):
     if cell is None:
         raise LookupError(f"no cell uid={uid} in {address!r}")
     return cell
+
+
+_PROXY_CELL = None
+
+
+def _proxy_cell_class():
+    """Lazy, cached ProxyCell class (avoids a circular import at module
+    load and an import-machinery hit per pickled object)."""
+    global _PROXY_CELL
+    if _PROXY_CELL is None:
+        from .node import ProxyCell
+
+        _PROXY_CELL = ProxyCell
+    return _PROXY_CELL
 
 
 class _Pickler(pickle.Pickler):
@@ -66,6 +85,10 @@ class _Pickler(pickle.Pickler):
             t = obj.target
             return ("ref", t.system.address, t.uid)
         if isinstance(obj, ActorCell):
+            return ("cell", obj.system.address, obj.uid)
+        if isinstance(obj, _proxy_cell_class()):
+            # A remote handle crossing another link re-encodes to the
+            # same (address, uid) token it was decoded from.
             return ("cell", obj.system.address, obj.uid)
         if isinstance(obj, RawRef):
             return ("rawref", obj.cell.system.address, obj.cell.uid)
