@@ -90,14 +90,26 @@ class Link:
 
 
 class Fabric:
-    def __init__(self, serialize: bool = False, async_links: bool = False) -> None:
+    def __init__(
+        self,
+        serialize: Optional[bool] = None,
+        async_links: Optional[bool] = None,
+    ) -> None:
         self._lock = threading.Lock()
         self.systems: Dict[str, "ActorSystem"] = {}
         self.crashed: set = set()
         self._links: Dict[Tuple[str, str], Link] = {}
         self._subscribers: List["ActorCell"] = []
-        self.serialize = serialize
-        self.async_links = async_links
+        # None = auto: wire mode (byte serialization + async FIFO links)
+        # switches ON as soon as the fabric carries a second system.  A
+        # multi-node test written without thinking about link modes must
+        # get the discipline a real deployment forces — object identity
+        # across "nodes" only survives by explicit opt-out
+        # (serialize=False, the perf escape hatch).
+        self._serialize_opt = serialize
+        self._async_opt = async_links
+        self.serialize = bool(serialize)
+        self.async_links = bool(async_links)
         self._queue: deque = deque()
         self._cv = threading.Condition()
         self._worker: Optional[threading.Thread] = None
@@ -111,6 +123,11 @@ class Fabric:
     def register_system(self, system: "ActorSystem") -> None:
         with self._lock:
             self.systems[system.address] = system
+            if len(self.systems) >= 2:
+                if self._serialize_opt is None:
+                    self.serialize = True
+                if self._async_opt is None:
+                    self.async_links = True
             subscribers = list(self._subscribers)
         for subscriber in subscribers:
             subscriber.tell(MemberUp(system.address))
