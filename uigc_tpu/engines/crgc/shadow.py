@@ -21,6 +21,11 @@ if TYPE_CHECKING:  # pragma: no cover
     from .refob import CrgcRefob
 
 
+def _cell_path(cell) -> str:
+    """Stable display name for a cell (real or remote proxy)."""
+    return getattr(cell, "path", repr(cell))
+
+
 class Shadow:
     """Collector-side image of one actor (reference: Shadow.java:10-54)."""
 
@@ -304,6 +309,64 @@ class ShadowGraph:
             mine_out = {s.self_cell: c for s, c in mine.outgoing.items()}
             their_out = {s.self_cell: c for s, c in theirs.outgoing.items()}
             assert mine_out == their_out, (mine, theirs)
+
+    def addresses_in_graph(self) -> Dict[str, int]:
+        """Uncollected shadows per node address
+        (reference: ShadowGraph.java:331-340, structured instead of
+        printed)."""
+        counts: Dict[str, int] = {}
+        for shadow in self.from_set:
+            counts[shadow.location] = counts.get(shadow.location, 0) + 1
+        return counts
+
+    def investigate_live_set(self) -> Dict[str, object]:
+        """Structured dump of why the live set is what it is
+        (reference: ShadowGraph.java:342-394): population counters plus
+        the cross-locality acquaintances that usually explain a leak
+        suspicion (a local actor apparently held remotely, or vice
+        versa)."""
+        non_interned = roots = busy = nonzero_recv = nonlocal_ = 0
+        root_acquaintances: Dict[str, int] = {}
+        local_to_remote: List[tuple] = []
+        remote_to_local = 0
+        for shadow in self.from_set:
+            if not shadow.interned:
+                non_interned += 1
+            if shadow.is_root:
+                roots += 1
+                root_acquaintances[_cell_path(shadow.self_cell)] = len(
+                    shadow.outgoing
+                )
+            if shadow.is_busy:
+                busy += 1
+            if shadow.recv_count != 0:
+                nonzero_recv += 1
+            if not shadow.is_local:
+                nonlocal_ += 1
+                for out in shadow.outgoing:
+                    if out.is_local:
+                        remote_to_local += 1
+            else:
+                for out, count in shadow.outgoing.items():
+                    if not out.is_local:
+                        local_to_remote.append(
+                            (
+                                _cell_path(shadow.self_cell),
+                                _cell_path(out.self_cell),
+                                count,
+                            )
+                        )
+        return {
+            "total": len(self.from_set),
+            "non_interned": non_interned,
+            "roots": roots,
+            "busy": busy,
+            "nonzero_recv": nonzero_recv,
+            "nonlocal": nonlocal_,
+            "root_acquaintances": root_acquaintances,
+            "local_to_remote": sorted(local_to_remote),
+            "remote_to_local_count": remote_to_local,
+        }
 
     def count_reachable_from(self, address: str) -> int:
         """How many actors are reachable from actors at ``address``
