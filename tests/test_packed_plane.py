@@ -374,18 +374,30 @@ def test_ring_wraps_and_grows():
 def test_ring_concurrent_writer_reader():
     """Smoke the SPSC contract: one writer thread, one reader thread,
     every committed row arrives exactly once in order."""
+    import time
+
     ring = PackedRing(width=2, cap=16)
     total = 20_000
     seen = []
     stop = threading.Event()
 
     def reader():
-        while not stop.is_set() or True:
+        while True:
             got = ring.drain()
             if got is not None:
                 seen.append(got[:, 0].copy())
-            if stop.is_set() and got is None:
+            elif stop.is_set():
+                # one final drain AFTER observing stop: the writer may
+                # have committed between our empty drain and the flag
+                got = ring.drain()
+                if got is not None:
+                    seen.append(got[:, 0].copy())
                 break
+            else:
+                # yield instead of busy-spinning: under a loaded
+                # machine a spinning reader can starve the writer (and
+                # this test's join) for tens of seconds
+                time.sleep(0.0005)
 
     t = threading.Thread(target=reader)
     t.start()
@@ -395,7 +407,7 @@ def test_ring_concurrent_writer_reader():
         v[1] = -i
         ring.commit()
     stop.set()
-    t.join(timeout=30)
+    t.join(timeout=120)
     assert not t.is_alive()
     flat = np.concatenate(seen) if seen else np.empty(0)
     assert flat.shape[0] == total

@@ -32,6 +32,7 @@ from ...ops import pallas_incremental as pallas_incremental_kinds
 from ...ops import trace as trace_ops
 from ...ops.i64map import I64Map, IntStack
 from ...utils import events
+from . import refob as refob_info
 from .messages import StopMsg, WaveMsg
 from .state import CrgcContext, Entry
 
@@ -281,8 +282,6 @@ class ArrayShadowGraph:
     # ------------------------------------------------------------- #
 
     def merge_entry(self, entry: Entry) -> None:
-        from . import refob as refob_info
-
         self_slot = self.slot_for(entry.self_ref.target)
         flags = self.flags
         flags[self_slot] |= _F.FLAG_INTERNED | _F.FLAG_LOCAL
@@ -768,14 +767,27 @@ class ArrayShadowGraph:
                     self.edge_dst,
                     self.edge_weight,
                 )
-        return trace_ops.trace_marks_np(
-            self.flags,
-            self.recv_count,
-            self.supervisor,
-            self.edge_src,
-            self.edge_dst,
-            self.edge_weight,
-        )
+        # Host path: slice to the occupancy watermark.  Slots allocate
+        # lowest-first (IntStack from_range), so live slots cluster low
+        # and the 12-sweep fixpoint need not scan the grown capacity —
+        # two O(capacity) scans here replace O(capacity) work in every
+        # sweep.  Safe: flags beyond the last in-use slot are zero, and
+        # every nonzero-weight edge/supervisor references in-use slots.
+        nz = np.flatnonzero(self.flags)
+        h = int(nz[-1]) + 1 if nz.size else 0
+        enz = np.flatnonzero(self.edge_weight)
+        eh = int(enz[-1]) + 1 if enz.size else 0
+        mark = np.zeros(self.capacity, dtype=bool)
+        if h:
+            mark[:h] = trace_ops.trace_marks_np(
+                self.flags[:h],
+                self.recv_count[:h],
+                self.supervisor[:h],
+                self.edge_src[:eh],
+                self.edge_dst[:eh],
+                self.edge_weight[:eh],
+            )
+        return mark
 
     def _on_tpu(self) -> bool:
         tpu = getattr(self, "_is_tpu", None)

@@ -13,6 +13,7 @@ from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Iterable, Optional
 
 from ...interfaces import GCMessage, Refob, SpawnInfo
+from ...runtime.signals import _PostStop
 from ...utils import events
 from ..engine import Engine, TerminationDecision
 from .collector import Bookkeeper
@@ -304,8 +305,6 @@ class CRGC(Engine):
     # per-link admitted counts (reference: IngressEntry.java:91-100).
 
     def pre_signal(self, signal: Any, state: CrgcState, ctx: "ActorContext") -> None:
-        from ...runtime.signals import _PostStop
-
         if not isinstance(signal, _PostStop):
             return
         leftovers = ctx.cell.drain_mailbox()

@@ -223,7 +223,10 @@ def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None):
     wake benchmark scans K of them in one jit)."""
     if interpret is None:
         interpret = pt.default_interpret()
-    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret)
+    # _int8_mxu in the key: the flag is read at kernel build time, so
+    # flipping UIGC_KERNEL_INT8 between runs A/Bs both datapaths in one
+    # process instead of requiring a restart per arm.
+    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret, pt._int8_mxu())
     fn = _fn_cache.get(key)
     if fn is None:
         fn = _fn_cache[key] = _build_wake_fn(

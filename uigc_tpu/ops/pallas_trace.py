@@ -105,15 +105,12 @@ def _int8_mxu() -> bool:
     """UIGC_KERNEL_INT8=1 runs the one-hot contraction in int8 with
     int32 accumulation (A and B are 0/1, so it is exact) — on chips
     whose MXU doubles int8 rate vs bf16 this is a candidate 2x when the
-    sweep is contraction-bound.  Read once at import so the kernel
-    caches stay consistent within a process; A/B by re-running the
-    bench with the env var set."""
+    sweep is contraction-bound.  Read at kernel BUILD time and part of
+    every kernel-cache key, so one process can A/B by flipping the env
+    var between runs — no restart needed."""
     import os
 
     return os.environ.get("UIGC_KERNEL_INT8", "") not in ("", "0")
-
-
-_INT8_MXU = _int8_mxu()
 
 
 def pack_hits_words(hits2d, jnp):
@@ -728,6 +725,7 @@ def build_propagate(
         group = d_group if group is None else group
     block_rows = ROWS * sub
     group_rows = ROWS * group
+    use_int8 = _int8_mxu()
 
     def kernel(*refs):
         if dst_gate:
@@ -801,8 +799,8 @@ def build_propagate(
                 jnp.zeros((block_rows, LANE), jnp.int32),
             )
             bits = jax.lax.shift_right_logical(words, bit_pos) & 1
-            mm_dt = jnp.int8 if _INT8_MXU else jnp.bfloat16
-            acc_dt = jnp.int32 if _INT8_MXU else jnp.float32
+            mm_dt = jnp.int8 if use_int8 else jnp.bfloat16
+            acc_dt = jnp.int32 if use_int8 else jnp.float32
             vals = bits.astype(mm_dt)
 
             # Fused one-hot segment-sum on the MXU: one
@@ -826,7 +824,7 @@ def build_propagate(
             a = jnp.concatenate(a_parts, axis=1)  # (s_rows, block_rows*LANE)
             b = jnp.concatenate(b_parts, axis=0)  # (block_rows*LANE, LANE)
             acc = jnp.dot(a, b, preferred_element_type=acc_dt)
-            if _INT8_MXU:
+            if use_int8:
                 acc = acc.astype(jnp.float32)
 
             @pl.when(first)
@@ -1015,7 +1013,7 @@ def get_trace_fn_multi(
     flags/recv)."""
     if interpret is None:
         interpret = default_interpret()
-    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret)
+    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret, _int8_mxu())
     fn = _fn_cache.get(key)
     if fn is None:
         fn = _build_trace_fn_multi(
