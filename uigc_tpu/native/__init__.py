@@ -83,6 +83,13 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.uigc_live_ids.argtypes = [ctypes.c_void_p, _p_i64]
     lib.uigc_count_reachable_from.restype = _i64
     lib.uigc_count_reachable_from.argtypes = [ctypes.c_void_p, _i64]
+    # batch probes for ops/i64map.py (table storage stays numpy-owned)
+    lib.uigc_map_get_batch.restype = None
+    lib.uigc_map_get_batch.argtypes = [_p_i64, _p_i64, _i64, _p_i64, _i64, _p_i64]
+    lib.uigc_map_put_batch_new.restype = _i64
+    lib.uigc_map_put_batch_new.argtypes = [_p_i64, _p_i64, _i64, _p_i64, _p_i64, _i64]
+    lib.uigc_map_pop_batch.restype = _i64
+    lib.uigc_map_pop_batch.argtypes = [_p_i64, _p_i64, _i64, _p_i64, _i64, _p_i64]
 
 
 def load() -> ctypes.CDLL:

@@ -21,15 +21,71 @@ a tombstone.  Scalar dict-compatible operations (`get`/`pop`/`[]`/`in`/
 
 from __future__ import annotations
 
+import ctypes
 from typing import Iterator, Optional, Tuple
 
 import numpy as np
+
+_C_I64_P = ctypes.POINTER(ctypes.c_int64)
 
 EMPTY = -1
 TOMBSTONE = -2
 
 _MULT = np.uint64(0x9E3779B97F4A7C15)
 _SHIFT = np.uint64(29)
+
+#: native probe kernels (uigc_tpu/native/crgc_shadow.cpp): serial C
+#: loops beat the numpy scatter-and-verify rounds once batches are big
+#: enough to amortize the call.  None = not probed yet, False = no
+#: toolchain (pure-numpy fallback).  The C side uses the identical hash
+#: and probe order, so both sides can operate on the same table.
+_native = None
+_NATIVE_MIN_BATCH = 64
+
+
+def _native_lib():
+    global _native
+    if _native is None:
+        try:
+            from ..native import load
+
+            _native = load()
+        except Exception:
+            _native = False
+    return _native or None
+
+
+def _native_lib_checked():
+    """Load + one-time hash-equivalence check: the C probes MUST agree
+    with _h_batch/_h_scalar on every slot choice (both sides operate on
+    the same table), so a retuned _MULT/_SHIFT here must refuse the
+    native path rather than silently mis-probe."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    global _native
+    if not getattr(_native_lib_checked, "_verified", False):
+        probe = np.array([0, 1, 0x7FFF_FFFF_FFFF_FFFF, 12345678901], np.int64)
+        mask = np.int64(1023)
+        expect = ((probe.astype(np.uint64) * _MULT) >> _SHIFT).astype(
+            np.int64
+        ) & mask
+        tab = np.full(1024, EMPTY, dtype=np.int64)
+        vals = np.arange(1024, dtype=np.int64)
+        # the four probe keys hash to distinct slots at mask 1023, so a
+        # correct C hash fills exactly the expected slot set
+        lib.uigc_map_put_batch_new(
+            _ptr(tab), _ptr(vals), mask, _ptr(probe), _ptr(probe), probe.size
+        )
+        if not np.array_equal(np.sort(np.nonzero(tab >= 0)[0]), np.sort(expect)):
+            _native = False
+            return None
+        _native_lib_checked._verified = True
+    return lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(_C_I64_P)
 
 
 class I64Map:
@@ -77,11 +133,19 @@ class I64Map:
     def get_batch(self, karr: np.ndarray) -> np.ndarray:
         """Values for ``karr`` (-1 where absent).  Keys need not be
         unique."""
-        karr = np.asarray(karr, dtype=np.int64)
+        karr = np.ascontiguousarray(karr, dtype=np.int64)
         n = karr.size
         out = np.full(n, -1, dtype=np.int64)
         if n == 0 or self.size == 0:
             return out
+        if n >= _NATIVE_MIN_BATCH:
+            lib = _native_lib_checked()
+            if lib is not None:
+                lib.uigc_map_get_batch(
+                    _ptr(self.keys), _ptr(self.vals), self.mask,
+                    _ptr(karr), n, _ptr(out),
+                )
+                return out
         idx = self._h_batch(karr)
         pending = np.arange(n)
         keys = self.keys
@@ -101,12 +165,22 @@ class I64Map:
         """Insert keys known to be UNIQUE and ABSENT (the fold path
         learns absence from get_batch first).  Scatter-and-verify:
         losers of a slot race keep probing."""
-        karr = np.asarray(karr, dtype=np.int64)
-        varr = np.asarray(varr, dtype=np.int64)
+        karr = np.ascontiguousarray(karr, dtype=np.int64)
+        varr = np.ascontiguousarray(varr, dtype=np.int64)
         n = karr.size
         if n == 0:
             return
         self._maybe_grow(n)
+        if n >= _NATIVE_MIN_BATCH:
+            lib = _native_lib_checked()
+            if lib is not None:
+                freed = lib.uigc_map_put_batch_new(
+                    _ptr(self.keys), _ptr(self.vals), self.mask,
+                    _ptr(karr), _ptr(varr), n,
+                )
+                self.size += n
+                self.tombs -= int(freed)
+                return
         keys = self.keys
         mask = self.mask
         idx = self._h_batch(karr)
@@ -139,11 +213,21 @@ class I64Map:
     def pop_batch(self, karr: np.ndarray) -> np.ndarray:
         """Remove ``karr`` (unique); returns their values (-1 where
         absent)."""
-        karr = np.asarray(karr, dtype=np.int64)
+        karr = np.ascontiguousarray(karr, dtype=np.int64)
         n = karr.size
         out = np.full(n, -1, dtype=np.int64)
         if n == 0 or self.size == 0:
             return out
+        if n >= _NATIVE_MIN_BATCH:
+            lib = _native_lib_checked()
+            if lib is not None:
+                removed = lib.uigc_map_pop_batch(
+                    _ptr(self.keys), _ptr(self.vals), self.mask,
+                    _ptr(karr), n, _ptr(out),
+                )
+                self.size -= int(removed)
+                self.tombs += int(removed)
+                return out
         keys = self.keys
         mask = self.mask
         idx = self._h_batch(karr)
