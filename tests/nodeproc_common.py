@@ -140,6 +140,8 @@ def run_child(spec: dict) -> None:
 
     config = dict(BASE)
     config["uigc.crgc.shadow-graph"] = backend
+    if "num_nodes" in spec:
+        config["uigc.crgc.num-nodes"] = spec["num_nodes"]
 
     fabric = NodeFabric()
     system = ActorSystem(None, name=address, config=config, fabric=fabric)
@@ -151,6 +153,20 @@ def run_child(spec: dict) -> None:
             Behaviors.setup_root(lambda ctx: Holder(ctx)), "holder"
         )
         fabric.register_name("holder", holder_handle.cell)
+    elif role == "spawner":
+        from uigc_tpu.runtime.remote import RemoteSpawner
+
+        probe_addr = f"uigc://{spec.get('probe_node', 'procA')}"
+
+        def worker_setup(ctx):
+            # probe looked up lazily at spawn time (the driver's hello,
+            # carrying the name, has arrived by then)
+            return Worker(ctx, RemoteProbe(fabric.lookup(probe_addr, "probe")))
+
+        spawner_cell = RemoteSpawner.spawn_service(
+            system, {"worker": Behaviors.setup(worker_setup)}
+        )
+        fabric.register_name("spawner", spawner_cell)
 
     port = fabric.listen()
     _say(f"READY {port}")

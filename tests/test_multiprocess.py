@@ -142,3 +142,80 @@ def test_multiprocess_three_node_crash_recovery(with_drops):
         if child_c is not None:
             child_c.shutdown()
         system.terminate()
+
+
+class SpawningRoot:
+    """Managed root on the driver: spawns a worker in the child process
+    through its RemoteSpawner, pings it, releases on command."""
+
+    def __new__(cls, context, spawner_proxy):
+        from uigc_tpu.runtime.behaviors import AbstractBehavior
+
+        from nodeproc_common import DropCmd, Ping
+
+        class _Root(AbstractBehavior):
+            def __init__(self, ctx):
+                super().__init__(ctx)
+                self.remote_worker = None
+
+            def on_message(self, msg):
+                ctx = self.context
+                if isinstance(msg, Ping):  # "go" trigger
+                    self.remote_worker = ctx.spawn_remote(
+                        "worker", spawner_proxy
+                    )
+                    for _ in range(3):
+                        self.remote_worker.tell(Ping(), ctx)
+                elif isinstance(msg, DropCmd):
+                    ctx.release(self.remote_worker)
+                return self
+
+        return _Root(context)
+
+
+def test_multiprocess_remote_spawn_and_collect():
+    """Cross-process remote spawn: the blocking ask crosses the socket
+    as a wire frame (runtime/remote.py _SpawnWire) and the reply
+    returns the spawned cell's token; releasing the only ref on the
+    driver then collects the worker in the child process via delta
+    gossip (the two-node remote-spawn test of test_multinode.py, with a
+    real process boundary)."""
+    from uigc_tpu.runtime.behaviors import Behaviors
+
+    from nodeproc_common import DropCmd, Ping, Spawned, Stopped
+
+    config = dict(BASE)
+    config["uigc.crgc.num-nodes"] = 2
+    config["uigc.crgc.shadow-graph"] = "array"
+
+    fabric = NodeFabric()
+    system = ActorSystem(None, name="procA", config=config, fabric=fabric)
+    child_b = None
+    try:
+        probe = TestProbe(default_timeout_s=30.0)
+        probe_cell = system.spawn_system_raw(ProbeForwarder(probe), "probe-fwd")
+        fabric.register_name("probe", probe_cell)
+        fabric.listen()
+
+        child_b = Child(
+            {"role": "spawner", "address": "procB", "num_nodes": 2}
+        )
+        fabric.connect("127.0.0.1", child_b.port)
+
+        spawner = fabric.lookup("uigc://procB", "spawner")
+        root = system.spawn_root(
+            Behaviors.setup_root(lambda ctx: SpawningRoot(ctx, spawner)),
+            "root",
+        )
+        root.tell(Ping())  # go
+        spawned = probe.expect_message_type(Spawned)
+        assert spawned.name.startswith("/system/RemoteSpawner/remote-")
+
+        time.sleep(0.4)
+        root.tell(DropCmd())  # driver releases the only ref
+        stopped = probe.expect_message_type(Stopped)
+        assert stopped.name == spawned.name
+    finally:
+        if child_b is not None:
+            child_b.shutdown()
+        system.terminate()
