@@ -189,14 +189,14 @@ def test_pipelined_decremental_collection():
         kit.shutdown()
 
 
-def test_pipelined_mesh_decremental_falls_back_sync():
+def test_pipelined_mesh_decremental_collection():
     """uigc.crgc.pipelined + shadow-graph=mesh-decremental: the mesh
-    backend must NOT take the base-class pipelined path (its
-    launch_trace would route through the single-device tracer and
-    clear the _pair_log that _sync_device needs, desyncing the shard
-    layouts).  MeshShadowGraph.can_pipeline is False, so the collector
-    falls back to the synchronous sharded trace — and garbage still
-    collapses."""
+    runs its OWN pipelined wake (launch syncs the shard layouts
+    mesh-natively, then dispatches the sharded decremental wake
+    asynchronously; the harvest sweeps the launch snapshot's verdicts).
+    Cyclic garbage still collapses, and the regression this guards: the
+    base-class path through the single-device tracer would have
+    desynced the shard layouts."""
     kit = ActorTestKit(
         {
             "uigc.crgc.wakeup-interval": 10,
@@ -206,7 +206,7 @@ def test_pipelined_mesh_decremental_falls_back_sync():
     )
     try:
         graph = kit.system.engine.bookkeeper.shadow_graph
-        assert graph.can_pipeline is False
+        assert graph.can_pipeline is True
         probe = kit.create_test_probe(timeout_s=60.0)
         root = kit.spawn(Behaviors.setup_root(lambda ctx: Root(ctx, probe)), "root")
         probe.expect_message_type(Spawned)
@@ -214,7 +214,6 @@ def test_pipelined_mesh_decremental_falls_back_sync():
         root.tell(Drop())
         probe.expect_message_type(Stopped)
         probe.expect_message_type(Stopped)
-        assert not graph.has_pending_wake
     finally:
         kit.shutdown()
 

@@ -146,8 +146,18 @@ import pytest
 
 @pytest.mark.parametrize(
     "backend,pipelined",
-    [("array", False), ("decremental", False), ("decremental", True)],
-    ids=["array", "decremental", "decremental-pipelined"],
+    [
+        ("array", False),
+        ("decremental", False),
+        ("decremental", True),
+        ("mesh-decremental", True),
+    ],
+    ids=[
+        "array",
+        "decremental",
+        "decremental-pipelined",
+        "mesh-decremental-pipelined",
+    ],
 )
 def test_random_churn_fully_collected(backend, pipelined):
     """Unsound GC kills live actors; incomplete GC times out.  The

@@ -54,8 +54,9 @@ DEFAULTS: Dict[str, Any] = {
     # asynchronously and sweeps the PREVIOUS wake's verdicts while the
     # current one runs, overlapping host ingest with the device trace
     # (SURVEY §7 hard parts).  Sound because CRGC garbage is monotone —
-    # a consistent-snapshot verdict never kills a live actor.  Only the
-    # decremental device backend supports it; others ignore the flag.
+    # a consistent-snapshot verdict never kills a live actor.  The
+    # decremental and mesh-decremental backends support it; others
+    # ignore the flag.
     "uigc.crgc.pipelined": False,
     # Packed mutator->collector entry plane (SURVEY §7): flushes write
     # int64 rows into per-thread ring buffers instead of object Entries,

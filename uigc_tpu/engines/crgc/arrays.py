@@ -892,22 +892,32 @@ class ArrayShadowGraph:
         )
         return self._dec
 
+    def _start_wake(self) -> tuple:
+        """Dispatch one asynchronous wake; returns ``(handle,
+        mark_dev)`` where the handle provides ``unpack_marks`` /
+        ``invalidate`` (the contract harvest_trace and
+        expire_stalled_wake consume).  Overridable: the mesh backend
+        dispatches its sharded wake here, while the snapshot and
+        bookkeeping stay in :meth:`launch_trace` — one home for the
+        pending-wake tuple layout."""
+        import jax
+
+        dec = self._synced_dec()
+        return dec, dec.wake_device(
+            jax.device_put(self.flags), jax.device_put(self.recv_count)
+        )
+
     def launch_trace(self) -> None:
         """Dispatch the device wake without waiting for its result.
         No-op while a wake is already in flight."""
         import time
 
-        import jax
-
         if self._pending_wake is not None:
             return
-        dec = self._synced_dec()
-        mark_w = dec.wake_device(
-            jax.device_put(self.flags), jax.device_put(self.recv_count)
-        )
+        handle, mark_dev = self._start_wake()
         self._pending_wake = (
-            dec,
-            mark_w,
+            handle,
+            mark_dev,
             self.flags.copy(),
             self.supervisor.copy(),
             time.monotonic(),

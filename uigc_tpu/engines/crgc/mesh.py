@@ -126,25 +126,25 @@ class MeshShadowGraph(ArrayShadowGraph):
 
     @property
     def can_pipeline(self) -> bool:
-        # The base-class pipelined path (launch_trace/harvest_trace)
-        # routes through the single-device DecrementalTracer and its
-        # _sync_layout clears self._pair_log, which _sync_device still
-        # needs — permanently desyncing the sharded layouts.  Until the
-        # mesh grows its own launch/harvest pair, pipelined collection
-        # must fall back to the synchronous sharded trace here.
-        return False
+        # The mesh pipelined wake overlaps host ingest with the SHARDED
+        # decremental wake: launch_trace syncs the shard layouts
+        # mesh-natively (the base-class path would have routed through
+        # the single-device tracer and desynced them) and dispatches
+        # the wake asynchronously; the base class's harvest machinery
+        # sweeps the snapshot verdicts through _MeshWakeHandle.
+        return self.decremental
 
-    def launch_trace(self) -> None:
-        raise NotImplementedError(
-            "MeshShadowGraph has no pipelined wake: the inherited "
-            "launch_trace would desync the shard layouts (see "
-            "can_pipeline)"
-        )
-
-    def harvest_trace(self, should_kill: bool) -> int:
-        raise NotImplementedError(
-            "MeshShadowGraph has no pipelined wake (see can_pipeline)"
-        )
+    def _start_wake(self) -> tuple:
+        """Dispatch the sharded decremental wake asynchronously (the
+        base launch_trace keeps the snapshot bookkeeping).  The shard
+        layouts sync mesh-natively first; state commits at dispatch
+        (like DecrementalTracer.wake_device), so a pending wake
+        discarded by a synchronous trace loses nothing."""
+        with events.recorder.timed(events.DEVICE_TRACE):
+            self._sync_device()
+            self.stats["wakes"] += 1
+            out = self._dispatch_decremental_wake(self._layout_meta)
+        return _MeshWakeHandle(self), out[0]
 
     # ------------------------------------------------------------- #
     # Device state construction
@@ -494,10 +494,14 @@ class MeshShadowGraph(ArrayShadowGraph):
             )
             return np.asarray(mark)[: self.capacity]
 
-    def _compute_marks_decremental(self, meta) -> np.ndarray:
-        """The closure+repair wake on the mesh: regional re-derivation
-        per shard, one word all_gather per sweep.  A zeroed previous
-        state (cold start, post-rebuild) is the full derivation."""
+    def _dispatch_decremental_wake(self, meta) -> tuple:
+        """Dispatch one closure+repair wake on the mesh (regional
+        re-derivation per shard, one word all_gather per sweep; a
+        zeroed previous state — cold start, post-rebuild — is the full
+        derivation).  State and suspects COMMIT at dispatch; an
+        async-poisoned result surfaces at the first readback, where the
+        caller invalidates so the next wake re-derives from zero state
+        instead of feeding poisoned arrays forever."""
         import jax
 
         key = ("dec", self._n_pad, meta["n_blocks"], self._bucket_m)
@@ -536,19 +540,16 @@ class MeshShadowGraph(ArrayShadowGraph):
             self._dev_psrc,
             self._dev_pdst,
         )
-        # The mark readback is the first point a poisoned async result
-        # surfaces; commit state + drain suspects only after it, and
-        # invalidate on failure so the next wake re-derives from zero
-        # state instead of feeding poisoned arrays forever.
-        try:
-            mark = np.asarray(out[0])[: self.capacity]
-        except Exception:
-            self.invalidate_wake_state()
-            raise
         self._wake_state = list(out[1:])
         self._pending_del_dst.clear()
         self._pending_fresh_dst.clear()
-        return mark
+        return out
+
+    def _compute_marks_decremental(self, meta) -> np.ndarray:
+        # same readback + poisoned-result recovery as the pipelined path
+        return _MeshWakeHandle(self).unpack_marks(
+            self._dispatch_decremental_wake(meta)[0]
+        )
 
     def invalidate_wake_state(self) -> None:
         """Drop the previous-fixpoint state (failed/poisoned wake): the
@@ -556,3 +557,32 @@ class MeshShadowGraph(ArrayShadowGraph):
         self._wake_state = None
         self._pending_del_dst.clear()
         self._pending_fresh_dst.clear()
+
+
+class _MeshWakeHandle:
+    """Adapter giving the base class's pipelined harvest machinery
+    (ArrayShadowGraph.harvest_trace / expire_stalled_wake) the two
+    operations it needs from an in-flight mesh wake.  The wake's state
+    was already committed at dispatch, so unpacking is a pure readback;
+    a poisoned result auto-invalidates, same contract as
+    DecrementalTracer.unpack_marks."""
+
+    __slots__ = ("graph", "n")
+
+    def __init__(self, graph: "MeshShadowGraph"):
+        self.graph = graph
+        #: capacity at launch: the harvest sweeps against the LAUNCH
+        #: snapshot, so the mark vector must match the snapshot's
+        #: length even if capacity grew in between (the base harvest
+        #: pads the grown tail — no verdict exists for it)
+        self.n = graph.capacity
+
+    def unpack_marks(self, mark_dev) -> np.ndarray:
+        try:
+            return np.asarray(mark_dev)[: self.n]
+        except Exception:
+            self.graph.invalidate_wake_state()
+            raise
+
+    def invalidate(self) -> None:
+        self.graph.invalidate_wake_state()
