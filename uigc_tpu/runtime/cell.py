@@ -25,7 +25,9 @@ import traceback
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 
+from ..engines.engine import TerminationDecision
 from ..interfaces import GCMessage, Message
+from .behaviors import SameBehavior, StoppedBehavior
 from .signals import PostStop, Terminated
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -235,9 +237,6 @@ class ActorCell:
     def _invoke(self, msg: Any) -> None:
         """Deliver one message through the engine sandwich (reference:
         AbstractBehavior.scala:16-31)."""
-        from ..engines.engine import TerminationDecision
-        from .behaviors import StoppedBehavior
-
         behavior = self.behavior
         if not self.is_managed:
             try:
@@ -279,9 +278,6 @@ class ActorCell:
     def _invoke_signal(self, signal: Any) -> None:
         """Deliver a lifecycle signal through the engine sandwich
         (reference: AbstractBehavior.scala:33-54)."""
-        from ..engines.engine import TerminationDecision
-        from .behaviors import StoppedBehavior
-
         behavior = self.behavior
         if behavior is None:
             return
@@ -310,12 +306,8 @@ class ActorCell:
             self._apply_behavior_result(result)
 
     def _apply_behavior_result(self, result: Any) -> None:
-        from .behaviors import SameBehavior
-
         if result is None or isinstance(result, SameBehavior) or result is self.behavior:
             return
-        from .behaviors import StoppedBehavior
-
         if isinstance(result, StoppedBehavior):
             self._initiate_stop()
         else:
