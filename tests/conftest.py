@@ -44,6 +44,11 @@ def pytest_configure(config):
         "tpu: compiled-on-TPU parity tier (run with UIGC_TEST_TPU=1 on a "
         "machine with a real chip; skipped in the default CPU tier)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long randomized runs (chaos long-haul, determinism "
+        "replays); excluded from the tier-1 gate via -m 'not slow'",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

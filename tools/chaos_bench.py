@@ -1,0 +1,245 @@
+"""Chaos bench: failure-detection and recovery latency under a seeded
+fault plan (runtime/faults.py + runtime/heartbeat.py).
+
+Spins a three-node cluster in ONE process over real localhost sockets
+(the same transport as the multi-process tests, with every node
+inspectable), runs actor churn across the links while a seeded
+``FaultPlan`` drops/duplicates/reorders/truncates app frames on the
+doomed node's links, then kills the doomed node SILENTLY (links muted,
+engine stopped, sockets left open — no EOF).  Measures, per seed:
+
+- detection latency: silent death -> heartbeat NODE_DOWN verdict
+- finalize latency:  death -> both survivors' dead links finalized
+- recovery latency:  death -> undo-log quorum folded on both survivors
+- convergence:       time until every surviving recv balance is zero
+- wire damage:       frames dropped/duplicated/corrupt, gaps, dead letters
+
+Prints one JSON object; commit as ``BENCH_CHAOS_r{N}.json``.
+
+Usage: python tools/chaos_bench.py [--seeds 3] [--churn 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BASE = {
+    "uigc.crgc.wakeup-interval": 10,
+    "uigc.crgc.egress-finalize-interval": 5,
+    "uigc.crgc.shadow-graph": "array",
+    "uigc.crgc.num-nodes": 3,
+    "uigc.node.heartbeat-interval": 40,
+    "uigc.node.phi-threshold": 6.0,
+    "uigc.node.heartbeat-pause": 400,
+}
+
+from uigc_tpu import AbstractBehavior, Behaviors, Message, NoRefs  # noqa: E402
+
+
+# Message/behavior classes live at module level so the wire codec can
+# pickle them (a local class has no importable qualname).
+
+
+class Ping(NoRefs):
+    pass
+
+
+class Share(Message):
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,) if self.ref is not None else ()
+
+
+class Drop(NoRefs):
+    pass
+
+
+class Worker(AbstractBehavior):
+    def on_message(self, msg):
+        return self
+
+
+class Holder(AbstractBehavior):
+    def __init__(self, context):
+        super().__init__(context)
+        self.held = None
+
+    def on_message(self, msg):
+        if isinstance(msg, Share):
+            self.held = msg.ref
+        if self.held is not None:
+            self.held.tell(Ping(), self.context)
+        return self
+
+
+class Owner(AbstractBehavior):
+    def __init__(self, context, holder_ref):
+        super().__init__(context)
+        self.worker = context.spawn(
+            Behaviors.setup(lambda c: Worker(c)), "worker"
+        )
+        self.holder_ref = holder_ref
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, Share):
+            self.holder_ref.tell(
+                Share(ctx.create_ref(self.worker, self.holder_ref)), ctx
+            )
+        elif isinstance(msg, Drop):
+            ctx.release(self.worker)
+        return self
+
+
+def run_seed(seed: int, churn: int) -> dict:
+    from uigc_tpu.runtime.faults import FaultPlan
+    from uigc_tpu.runtime.node import NodeFabric
+    from uigc_tpu.runtime.system import ActorSystem
+    from uigc_tpu.utils import events
+
+    plan = FaultPlan(seed)
+    names = [f"cb{seed}a", f"cb{seed}b", f"cb{seed}c"]
+    fabrics, systems, ports = [], [], []
+    for n in names:
+        f = NodeFabric(fault_plan=plan)
+        s = ActorSystem(None, name=n, config=dict(BASE), fabric=f)
+        fabrics.append(f)
+        systems.append(s)
+        ports.append(f.listen())
+    addr = [s.address for s in systems]
+    for i in range(3):
+        for j in range(i + 1, 3):
+            fabrics[i].connect("127.0.0.1", ports[j])
+
+    for src, dst in ((addr[1], addr[2]), (addr[2], addr[1]),
+                     (addr[0], addr[2]), (addr[2], addr[0])):
+        plan.drop(src=src, dst=dst, kind="app", prob=0.2)
+        plan.duplicate(src=src, dst=dst, kind="app", prob=0.2)
+        plan.reorder(src=src, dst=dst, kind="app", prob=0.1)
+        plan.truncate(src=src, dst=dst, kind="app", prob=0.1)
+
+    marks: dict = {"down": {}, "final": {}, "fold": {}}
+    lock = threading.Lock()
+
+    def listener(name, fields):
+        now = time.perf_counter()
+        with lock:
+            if name == events.NODE_DOWN and fields.get("address") == addr[2]:
+                marks["down"].setdefault(fields.get("reason"), now)
+            elif name == events.DEAD_LINK_FINALIZED and fields.get("src") == addr[2]:
+                marks["final"].setdefault(fields.get("dst"), now)
+            elif name == events.UNDO_FOLD and fields.get("address") == addr[2]:
+                marks["fold"].setdefault(fields.get("node"), now)
+
+    events.recorder.enable()
+    events.recorder.add_listener(listener)
+
+    holder = systems[2].spawn_root(
+        Behaviors.setup_root(lambda ctx: Holder(ctx)), "holder"
+    )
+    holder_proxy = fabrics[1]._proxy(addr[2], holder.cell.uid)
+    owner = systems[1].spawn_root(
+        Behaviors.setup_root(
+            lambda ctx: Owner(ctx, ctx.engine.to_root_refob(holder_proxy))
+        ),
+        "owner",
+    )
+    owner.tell(Share(None))
+    for _ in range(churn):
+        holder.tell(Ping())
+        time.sleep(0.001)
+    owner.tell(Drop())
+    time.sleep(0.3)
+
+    # Silent death of node C: no EOF, only heartbeat silence.
+    t_kill = time.perf_counter()
+    plan.isolate(addr[2])
+    systems[2].engine.on_crash()
+
+    def survivors_converged():
+        balances_zero = all(
+            s.engine.bookkeeper.shadow_graph.investigate_live_set()["nonzero_recv"]
+            == 0
+            for s in systems[:2]
+        )
+        with lock:
+            folded = len(marks["fold"]) >= 2
+        return balances_zero and folded
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not survivors_converged():
+        time.sleep(0.02)
+    t_conv = time.perf_counter()
+
+    drops = sum(v for k, v in plan.stats.items() if k[0] == "drop")
+    dups = sum(v for k, v in plan.stats.items() if k[0] == "duplicate")
+    snap = events.recorder.snapshot()["counts"]
+    result = {
+        "seed": seed,
+        "converged": survivors_converged(),
+        "detect_s": round(marks["down"].get("heartbeat", t_conv) - t_kill, 3),
+        "finalize_s": round(max(marks["final"].values(), default=t_conv) - t_kill, 3),
+        "undo_fold_s": round(max(marks["fold"].values(), default=t_conv) - t_kill, 3),
+        "converge_s": round(t_conv - t_kill, 3),
+        "frames_dropped": drops,
+        "frames_duplicated": dups,
+        "dup_discards": snap.get(events.FRAME_DUPLICATE, 0),
+        "gaps": snap.get(events.FRAME_GAP, 0),
+        "corrupt": snap.get(events.FRAME_CORRUPT, 0),
+        "dead_letters": snap.get(events.DEAD_LETTER, 0),
+    }
+
+    events.recorder.remove_listener(listener)
+    events.recorder.disable()
+    events.recorder.reset()
+    for s in systems:
+        try:
+            s.terminate(timeout_s=5.0)
+        except Exception:
+            pass
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=3)
+    ap.add_argument("--churn", type=int, default=200)
+    args = ap.parse_args()
+    runs = [run_seed(1000 + i, args.churn) for i in range(args.seeds)]
+    ok = [r for r in runs if r["converged"]]
+    print(
+        json.dumps(
+            {
+                "bench": "chaos recovery latency (tools/chaos_bench.py)",
+                "config": {
+                    k: v for k, v in BASE.items() if k.startswith("uigc.node")
+                },
+                "runs": runs,
+                "converged": f"{len(ok)}/{len(runs)}",
+                "detect_s_median": sorted(r["detect_s"] for r in runs)[
+                    len(runs) // 2
+                ],
+                "converge_s_median": sorted(r["converge_s"] for r in runs)[
+                    len(runs) // 2
+                ],
+            },
+            indent=2,
+        )
+    )
+    import os
+
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

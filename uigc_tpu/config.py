@@ -78,6 +78,24 @@ DEFAULTS: Dict[str, Any] = {
     # host Tarjan to the device SCC kernel (ops/scc.py).  0 forces the
     # device path; large values keep detection host-side.
     "uigc.mac.device-scc-threshold": 4096,
+    # --- Node transport settings (runtime/node.py; no reference
+    # analogue — the reference delegates failure detection to Akka
+    # Cluster, we carry our own) ---
+    # Milliseconds between heartbeat pings on each peer link; 0 disables
+    # the phi-accrual failure detector (EOF remains the only signal).
+    "uigc.node.heartbeat-interval": 0,
+    # Phi threshold at which a silent peer is declared dead
+    # (phi = -log10 P(still alive); 8 ~= one false positive in 1e8).
+    "uigc.node.phi-threshold": 8.0,
+    # Milliseconds of acceptable extra pause folded into the phi model
+    # (absorbs GC/compile stalls on loaded hosts).
+    "uigc.node.heartbeat-pause": 500,
+    # Reconnect attempts after a torn link before declaring the peer
+    # dead; 0 = declare on first EOF (the pre-heartbeat behavior).
+    "uigc.node.reconnect-retries": 0,
+    # Milliseconds of backoff before the first reconnect attempt,
+    # doubled per attempt.
+    "uigc.node.reconnect-backoff": 50,
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,
@@ -105,6 +123,9 @@ class Config:
 
     def get_int(self, key: str) -> int:
         return int(self.get(key))
+
+    def get_float(self, key: str) -> float:
+        return float(self.get(key))
 
     def get_bool(self, key: str) -> bool:
         value = self.get(key)

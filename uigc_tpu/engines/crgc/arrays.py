@@ -561,18 +561,15 @@ class ArrayShadowGraph:
         R = rows[order]
 
         self_slots = self._slots_for_uids(R[:, 1])
-        if (self_slots < 0).any():
-            keep = self_slots >= 0
-            R = R[keep]
-            self_slots = self_slots[keep]
-        seq = R[:, 0]
-        bits = R[:, 2]
-        recv = R[:, 3]
         c0 = 4
-        created = R[:, c0 : c0 + 2 * E]
-        spawned = R[:, c0 + 2 * E : c0 + 3 * E]
-        upd = R[:, c0 + 3 * E : c0 + 5 * E]
 
+        # Created (owner,target) pairs are extracted BEFORE the
+        # self-uid keep filter: the facts name only the owner and the
+        # target, not the flushing actor, so an unresolvable flusher
+        # must not drop edges between two other, still-live actors —
+        # an under-counted live edge is exactly the over-collection
+        # hazard the soundness invariant forbids (ADVICE r5).
+        created = R[:, c0 : c0 + 2 * E]
         ow = created[:, 0::2].ravel()
         tg = created[:, 1::2].ravel()
         vc = ow >= 0
@@ -581,6 +578,18 @@ class ArrayShadowGraph:
         tg_s = self._slots_for_uids(tg) if tg.size else tg
         cok = (ow_s >= 0) & (tg_s >= 0)
         ow_s, tg_s = ow_s[cok], tg_s[cok]
+
+        if (self_slots < 0).any():
+            # Only the flusher's OWN facts (self state, recv delta,
+            # spawned children, updated refobs) drop with it.
+            keep = self_slots >= 0
+            R = R[keep]
+            self_slots = self_slots[keep]
+        seq = R[:, 0]
+        bits = R[:, 2]
+        recv = R[:, 3]
+        spawned = R[:, c0 + 2 * E : c0 + 3 * E]
+        upd = R[:, c0 + 3 * E : c0 + 5 * E]
 
         sp = spawned.ravel()
         vs = sp >= 0

@@ -286,6 +286,12 @@ class Bookkeeper(RawBehavior):
             peer in log.finalized_by for peer in self.remote_gcs
         ):
             self.undone_gcs.add(addr)
+            events.recorder.commit(
+                events.UNDO_FOLD,
+                address=addr,
+                node=my_addr,
+                **log.summary(),
+            )
             self.shadow_graph.merge_undo_log(log)
             self.shadow_graph.trace(should_kill=True)
 

@@ -328,7 +328,11 @@ class CRGC(Engine):
     def on_dead_letter(self, cell: Any, msg: Any) -> None:
         """Account an AppMsg that arrived after the recipient terminated:
         one synthetic receive plus the release of every carried ref, folded
-        as an entry on the dead actor's behalf."""
+        as an entry on the dead actor's behalf.  ``cell`` may be a
+        tombstone ProxyCell when the frame crossed a process boundary and
+        the uid no longer resolves — the entry then folds under the same
+        stable (address, uid) key the sender's claims fold under, so the
+        balances cancel once both sides' facts arrive."""
         if not isinstance(msg, AppMsg):
             return
         refs = list(msg.refs)

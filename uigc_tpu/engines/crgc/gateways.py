@@ -250,6 +250,13 @@ class Ingress:
         final_entry.is_final = is_final
         self._send(final_entry)
 
+    def open_windows(self) -> list:
+        """Window ids still awaiting their boundary marker (chaos-bench
+        diagnostics; a healthy link converges to empty between
+        finalizations — windows that never close are admitted counts the
+        collector will only see at link death)."""
+        return sorted(self.entries)
+
     # Compatibility shim for the lockstep call shape (single window).
     def finalize_and_send(self, is_final: bool = False) -> None:
         self.finalize_all(is_final=is_final)

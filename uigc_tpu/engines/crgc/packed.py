@@ -25,7 +25,9 @@ Uids, not slots: slot assignment stays single-writer on the collector
 (ArrayShadowGraph.merge_packed maps uids through a dense ``uid -> slot``
 array and interns only unseen uids).  The plane's ``uid_strong`` dict
 pins every cell named by an in-flight row so the collector can always
-resolve it; the collector unpins at intern time.
+resolve it; pins live until the actor's slot is swept
+(ArrayShadowGraph._free_slots_batch pops them) — interning alone does
+not release a pin, it only makes future lookups bypass it.
 """
 
 from __future__ import annotations
@@ -116,8 +118,11 @@ class PackedPlane:
         #: the GIL, so concurrent flushes get distinct ordered stamps.
         self._seq = itertools.count()
         #: cells named by in-flight rows; dict.setdefault / .pop are
-        #: individually atomic under the GIL.  The collector pops a uid
-        #: once interned (the graph's own cells[] pins it from there).
+        #: individually atomic under the GIL.  Pins persist until the
+        #: collector SWEEPS the actor's slot (_free_slots_batch), not
+        #: until intern: the graph's cells[] also pins an interned cell,
+        #: so the extra pin is redundant but harmless, and releasing it
+        #: only at sweep keeps the release single-writer.
         self.uid_strong: Dict[int, object] = {}
         self._rings: Dict[int, PackedRing] = {}
         self._lock = threading.Lock()

@@ -81,6 +81,22 @@ class UndoLog:
         if entry.is_final:
             self.finalized_by.add(entry.ingress_address)
 
+    def summary(self) -> Dict[str, int]:
+        """Structured size of the net log (event fields for the
+        ``crgc.undo_fold`` commit and the chaos bench): how many actors
+        carry a reverted message balance or reverted created refs, and
+        how many surviving peers finalized."""
+        return {
+            "reverted_actors": len(self.admitted),
+            "reverted_messages": sum(
+                abs(f.message_count) for f in self.admitted.values()
+            ),
+            "reverted_refs": sum(
+                len(f.created_refs) for f in self.admitted.values()
+            ),
+            "finalized_by": len(self.finalized_by),
+        }
+
     @staticmethod
     def _update(outgoing: Dict[Any, int], target: Any, delta: int) -> None:
         count = outgoing.get(target, 0) + delta

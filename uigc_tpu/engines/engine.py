@@ -146,6 +146,13 @@ class Engine:
     def on_dead_letter(self, cell: "ActorCell", msg: Any) -> None:
         """Called when a message is delivered to a terminated actor.
 
+        ``cell`` is the addressee as the runtime can still name it: a
+        terminated-but-reachable ``ActorCell``, or — on a cross-process
+        fabric — the tombstone proxy for a uid that no longer resolves
+        (runtime/node.py routes post-mortem frames here so the sender's
+        already-stamped send still balances).  Implementations must not
+        assume a live local cell; only its identity key matters.
+
         No reference analogue as an SPI hook; engines that track message
         balances (CRGC) use this to account undelivered sends the way the
         reference's ingress stages account admitted messages across node

@@ -38,6 +38,7 @@ import threading
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils import events
 from . import wire
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -110,6 +111,10 @@ class Fabric:
         self._async_opt = async_links
         self.serialize = bool(serialize)
         self.async_links = bool(async_links)
+        #: seeded fault-injection policy (runtime/faults.py) — the same
+        #: object the cross-process NodeFabric consults, applied here at
+        #: the message admission edge (drop_messages rules + partitions).
+        self.fault_plan = None
         self._queue: deque = deque()
         self._cv = threading.Condition()
         self._worker: Optional[threading.Thread] = None
@@ -164,6 +169,9 @@ class Fabric:
         with self._lock:
             already = system.address in self.crashed
         if not already:
+            events.recorder.commit(
+                events.NODE_CRASHED, address=system.address, reason="injected"
+            )
             system.engine.on_crash()
         self.remove_system(system.address)
 
@@ -189,6 +197,12 @@ class Fabric:
     ) -> None:
         """Inject message drops on a link: fn(msg) -> True to drop."""
         self.link(src, dst).drop_filter = fn
+
+    def set_fault_plan(self, plan) -> None:
+        """Attach (or clear) a seeded ``FaultPlan`` (runtime/faults.py);
+        its message-level rules and partitions apply at the admission
+        edge of every link on this fabric."""
+        self.fault_plan = plan
 
     def deliver(
         self, src: "ActorSystem", target: "ActorCell", msg: Any
@@ -221,6 +235,16 @@ class Fabric:
             wire.decode_message(self, payload) if self.serialize else payload
         )
         if link.drop_filter is not None and link.drop_filter(msg):
+            return
+        if self.fault_plan is not None and self.fault_plan.drop_inbound(
+            link.src.address, link.dst.address, msg
+        ):
+            events.recorder.commit(
+                events.FRAME_DROPPED,
+                src=link.src.address,
+                dst=link.dst.address,
+                kind="app",
+            )
             return
         if link.dst.address in self.crashed:
             return
@@ -268,6 +292,9 @@ class Fabric:
             return
         with link.recv_lock:
             link.ingress.finalize_all(is_final=True)
+        events.recorder.commit(
+            events.DEAD_LINK_FINALIZED, src=src_address, dst=dst.address
+        )
 
     def control_send(self, src: "ActorSystem", target_cell: "ActorCell", msg: Any) -> None:
         """Collector control plane: reliable, ordered cell-to-cell
@@ -322,6 +349,11 @@ class Fabric:
                 else:  # "final"
                     with link.recv_lock:
                         link.ingress.finalize_all(is_final=True)
+                    events.recorder.commit(
+                        events.DEAD_LINK_FINALIZED,
+                        src=link.src.address,
+                        dst=link.dst.address,
+                    )
             except Exception:  # pragma: no cover - keep the lane alive
                 import traceback
 

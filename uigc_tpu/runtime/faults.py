@@ -1,0 +1,270 @@
+"""Deterministic fault injection for the node transports.
+
+Failure is a first-class, testable input to the runtime: a seeded
+:class:`FaultPlan` is one policy object that both fabrics consult —
+``NodeFabric`` (runtime/node.py) at its frame send/receive edges, and the
+in-process ``Fabric`` (runtime/fabric.py) at its message admission edge —
+generalizing the ad-hoc per-link drop filters into something a chaos test
+or ``tools/chaos_bench.py`` can construct once and replay exactly.
+
+Semantics at the sender edge (NodeFabric frames):
+
+- ``drop``      the frame is never transmitted but *consumes* a sequence
+                number, so the receiver observes a gap (the wire analogue
+                of a packet lost in flight after the egress stamp).
+- ``duplicate`` the frame is transmitted twice with the SAME sequence
+                number; the receiver's seq layer must discard the copy.
+- ``reorder``   the frame is held and transmitted after the next frame on
+                the link; the receiver sees an early frame (gap) and a
+                late one (discarded as duplicate) — a reordering network
+                under a FIFO transport contract.
+- ``delay``     the link stalls: this frame and the next ``frames`` ones
+                queue up, then release in order (FIFO preserved).
+- ``truncate``  the frame body is cut in half; the receiver fails to
+                decode it and drops it as corrupt.
+- partitions    every frame between a partitioned pair drops (both
+                directions) until ``heal`` — heartbeats included, which
+                is how failure-detector tests starve a node.
+- ``crash_at``  after this node transmits its N-th protocol frame
+                (heartbeats excluded — they are timer-driven and would
+                make the crash point wall-clock-dependent), the fabric
+                kills itself abruptly (``NodeFabric.die``): sockets close
+                with whatever the kernel already accepted, nothing
+                flushes — the in-process analogue of ``kill -9``.
+
+Determinism: each (src, dst) link gets its own RNG stream derived from
+the plan seed and the addresses (crc32, not the salted builtin hash), so
+probability draws on one link are not perturbed by traffic interleaving
+on another, and heartbeat frames (timer-driven, wall-clock-dependent)
+never consume draws or crash budget unless a rule names ``"hb"``
+explicitly.  Frame-level traces still depend on thread scheduling; the
+guarantee chaos tests rely on is outcome determinism — the same seed
+yields the same verdict distribution per link and the same crash point.
+One caveat: a ``count=`` budget is ONE counter shared by every link the
+rule matches, so thread interleaving decides which link spends it —
+combine ``count`` with explicit src/dst (as the chaos tests do) when
+per-link reproducibility matters.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from collections import Counter
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
+
+DELIVER = "deliver"
+DROP = "drop"
+DUPLICATE = "duplicate"
+REORDER = "reorder"
+DELAY = "delay"
+TRUNCATE = "truncate"
+
+_ACTIONS = (DROP, DUPLICATE, REORDER, DELAY, TRUNCATE)
+
+
+class _Rule:
+    __slots__ = ("action", "src", "dst", "kind", "prob", "count", "match", "frames")
+
+    def __init__(
+        self,
+        action: str,
+        src: str,
+        dst: str,
+        kind: Any,
+        prob: float,
+        count: Optional[int],
+        match: Optional[Callable[[Any], bool]],
+        frames: int = 0,
+    ):
+        self.action = action
+        self.src = src
+        self.dst = dst
+        self.kind = kind
+        self.prob = prob
+        self.count = count
+        self.match = match
+        self.frames = frames
+
+    def applies(self, src: str, dst: str, kind: str) -> bool:
+        if self.count is not None and self.count <= 0:
+            return False
+        if self.src != "*" and self.src != src:
+            return False
+        if self.dst != "*" and self.dst != dst:
+            return False
+        if self.kind != "*":
+            kinds = (self.kind,) if isinstance(self.kind, str) else self.kind
+            if kind not in kinds:
+                return False
+        return True
+
+
+class FaultPlan:
+    """A seeded, ordered set of fault rules plus live partitions and
+    scheduled crashes.  Thread-safe; one instance may be shared by every
+    node of an in-process cluster (links are keyed by address pair)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rules: List[_Rule] = []
+        self._inbound: List[_Rule] = []
+        self._partitions: set = set()  # frozenset({a, b})
+        self._crash_at: Dict[str, int] = {}
+        self._sent: Counter = Counter()
+        self._rngs: Dict[Tuple[str, str], random.Random] = {}
+        self._lock = threading.Lock()
+        #: observed verdicts, keyed (action, src, dst) — for tests/benches
+        self.stats: Counter = Counter()
+
+    # ------------------------------------------------------------- #
+    # Rule builders (chainable)
+    # ------------------------------------------------------------- #
+
+    def _add(self, rule: _Rule) -> "FaultPlan":
+        with self._lock:
+            self._rules.append(rule)
+        return self
+
+    def drop(self, src: str = "*", dst: str = "*", kind: Any = "*",
+             prob: float = 1.0, count: Optional[int] = None) -> "FaultPlan":
+        return self._add(_Rule(DROP, src, dst, kind, prob, count, None))
+
+    def duplicate(self, src: str = "*", dst: str = "*", kind: Any = "*",
+                  prob: float = 1.0, count: Optional[int] = None) -> "FaultPlan":
+        return self._add(_Rule(DUPLICATE, src, dst, kind, prob, count, None))
+
+    def reorder(self, src: str = "*", dst: str = "*", kind: Any = "*",
+                prob: float = 1.0, count: Optional[int] = None) -> "FaultPlan":
+        return self._add(_Rule(REORDER, src, dst, kind, prob, count, None))
+
+    def delay(self, src: str = "*", dst: str = "*", kind: Any = "*",
+              prob: float = 1.0, count: Optional[int] = None,
+              frames: int = 4) -> "FaultPlan":
+        """Stall the link: the matched frame and the next ``frames``
+        frames queue and then release in order (FIFO preserved)."""
+        return self._add(_Rule(DELAY, src, dst, kind, prob, count, None, frames))
+
+    def truncate(self, src: str = "*", dst: str = "*", kind: Any = "*",
+                 prob: float = 1.0, count: Optional[int] = None) -> "FaultPlan":
+        return self._add(_Rule(TRUNCATE, src, dst, kind, prob, count, None))
+
+    def drop_messages(self, src: str = "*", dst: str = "*",
+                      match: Optional[Callable[[Any], bool]] = None,
+                      prob: float = 1.0, count: Optional[int] = None) -> "FaultPlan":
+        """Message-level inbound drop (after decode, before the ingress
+        tally) — the generalization of the fabrics' drop filters."""
+        with self._lock:
+            self._inbound.append(_Rule(DROP, src, dst, "*", prob, count, match))
+        return self
+
+    def partition(self, a: str, b: str) -> "FaultPlan":
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+        return self
+
+    def heal(self, a: str, b: str) -> "FaultPlan":
+        with self._lock:
+            self._partitions.discard(frozenset((a, b)))
+        return self
+
+    def isolate(self, address: str) -> "FaultPlan":
+        """Partition ``address`` from everyone (wildcard partition)."""
+        with self._lock:
+            self._partitions.add(frozenset((address, "*")))
+        return self
+
+    def crash_at(self, address: str, after_frames: int) -> "FaultPlan":
+        """Schedule an abrupt self-crash of ``address`` after it has
+        transmitted (or dropped) ``after_frames`` frames."""
+        with self._lock:
+            self._crash_at[address] = after_frames
+        return self
+
+    # ------------------------------------------------------------- #
+    # Fabric-facing queries
+    # ------------------------------------------------------------- #
+
+    def _rng(self, src: str, dst: str) -> random.Random:
+        key = (src, dst)
+        rng = self._rngs.get(key)
+        if rng is None:
+            stream = zlib.crc32(f"{self.seed}|{src}|{dst}".encode())
+            rng = self._rngs[key] = random.Random((self.seed << 32) ^ stream)
+        return rng
+
+    def _partitioned(self, src: str, dst: str) -> bool:
+        return (
+            frozenset((src, dst)) in self._partitions
+            or frozenset((src, "*")) in self._partitions
+            or frozenset((dst, "*")) in self._partitions
+        )
+
+    def outbound(self, src: str, dst: str, kind: str) -> Tuple[str, int]:
+        """Verdict for one outbound frame on link src->dst.  Returns
+        (action, frames) where frames is only meaningful for DELAY.
+
+        Heartbeat frames (kind ``"hb"``) are timer-driven, so their
+        count before the N-th protocol frame is wall-clock-dependent;
+        letting wildcard rules draw on them would perturb the per-link
+        RNG streams across runs.  They therefore match only rules that
+        name ``"hb"`` explicitly — partitions still drop them, which is
+        how failure-detector tests starve a node."""
+        with self._lock:
+            if self._partitioned(src, dst):
+                self.stats[(DROP, src, dst)] += 1
+                return DROP, 0
+            rng = self._rng(src, dst)
+            for rule in self._rules:
+                if kind == "hb" and rule.kind == "*":
+                    continue
+                if not rule.applies(src, dst, kind):
+                    continue
+                if rule.prob < 1.0 and rng.random() >= rule.prob:
+                    continue
+                if rule.count is not None:
+                    rule.count -= 1
+                self.stats[(rule.action, src, dst)] += 1
+                return rule.action, rule.frames
+        return DELIVER, 0
+
+    def drop_inbound(self, src: str, dst: str, msg: Any) -> bool:
+        """Message-level inbound verdict (post-decode, pre-ingress)."""
+        with self._lock:
+            if self._partitioned(src, dst):
+                self.stats[(DROP, src, dst)] += 1
+                return True
+            rng = self._rng(src, dst)
+            for rule in self._inbound:
+                if not rule.applies(src, dst, "*"):
+                    continue
+                if rule.match is not None and not rule.match(msg):
+                    continue
+                if rule.prob < 1.0 and rng.random() >= rule.prob:
+                    continue
+                if rule.count is not None:
+                    rule.count -= 1
+                self.stats[(DROP, src, dst)] += 1
+                return True
+        return False
+
+    def record_sent(self, address: str, kind: str = "") -> bool:
+        """Count one transmitted-or-dropped frame for ``address``;
+        True when its scheduled crash point is reached (exactly once).
+        Heartbeat frames are not counted — they are timer-driven, so
+        counting them would make the crash point wall-clock-dependent
+        instead of a deterministic position in the protocol stream."""
+        if kind == "hb":
+            return False
+        with self._lock:
+            self._sent[address] += 1
+            at = self._crash_at.get(address)
+            if at is not None and self._sent[address] >= at:
+                del self._crash_at[address]
+                return True
+        return False
+
+    def frames_sent(self, address: str) -> int:
+        with self._lock:
+            return self._sent[address]

@@ -22,11 +22,31 @@ What maps where:
 - collector gossip: delta graphs and ingress-entry rebroadcasts cross in
                   their own wire formats (DeltaGraph.java:189-232,
                   IngressEntry.java:103-144)
-- membership:     a peer's connection dying (e.g. ``kill -9``) is the
-                  failure detector — EOF marks the member removed, and
-                  everything the dead node sent before dying was already
-                  delivered in order (TCP flushes the kernel buffer),
-                  matching the reference's drain-then-finalize semantics
+- membership:     two failure signals feed the same verdict.  EOF (e.g.
+                  ``kill -9`` tears the socket) marks the member removed
+                  after everything the dead node sent was delivered in
+                  order; a phi-accrual heartbeat monitor
+                  (runtime/heartbeat.py, ``uigc.node.heartbeat-interval``)
+                  additionally detects *silent* death — a wedged peer or
+                  a partition produces no EOF — and drives the identical
+                  ``MemberRemoved`` -> ``finalize_dead_link`` recovery.
+                  With ``uigc.node.reconnect-retries`` > 0 a torn socket
+                  is first re-dialed with exponential backoff; per-link
+                  frame sequence numbers let the receiving side discard
+                  duplicates and *detect* gaps across the reconnect
+                  instead of silently double-tallying ingress windows.
+- fault injection: a seeded ``FaultPlan`` (runtime/faults.py) is
+                  consulted on every frame edge — drop / duplicate /
+                  reorder / delay / truncate / partition / crash-at-frame
+                  — so node death is a deterministic, testable input
+                  rather than an untested EOF edge case.
+- dead letters:   a frame whose target uid no longer resolves still
+                  tallies on the ingress (keyed by the cached proxy for
+                  that uid) and releases the refs the decoded message
+                  carries, mirroring ``CRGC.on_dead_letter`` — the
+                  sender's egress already stamped the send, so dropping
+                  it silently would leave the link's recv balance
+                  permanently nonzero and leak every carried ref.
 - remote cells:   ``ProxyCell`` stands in for a cell of another process:
                   same (address, uid) token the wire codec uses, cached
                   per fabric so one remote actor folds to one shadow slot
@@ -34,13 +54,17 @@ What maps where:
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
 import threading
+import time
+import traceback
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
 
-from . import wire
+from ..utils import events
+from . import faults, wire
 
 if TYPE_CHECKING:  # pragma: no cover
     from .cell import ActorCell
@@ -125,6 +149,73 @@ class _HalfLink:
         self.drop_filter: Optional[Callable[[Any], bool]] = None
 
 
+class _PeerState:
+    """Per-peer transport state that must survive reconnects: sequence
+    counters (a fresh socket continues the old stream's numbering, which
+    is what lets the receiver discard retransmitted duplicates and
+    *detect* lost frames as gaps), fault-injection hold queues, and the
+    dial info used to re-establish a torn link."""
+
+    __slots__ = (
+        "lock",
+        "rlock",
+        "seq_out",
+        "seq_in",
+        "gaps",
+        "dups",
+        "held",
+        "stall",
+        "stall_q",
+        "dial",
+        "reconnecting",
+        "pending_break",
+        "nonce",
+    )
+
+    def __init__(self) -> None:
+        #: serializes seq assignment + socket writes (sender side)
+        self.lock = threading.Lock()
+        #: serializes seq acceptance (receiver side; separate from the
+        #: send lock so socket backpressure on the outbound half can
+        #: never deadlock against frame intake on the inbound half)
+        self.rlock = threading.Lock()
+        self.seq_out = 0
+        self.seq_in = 0
+        self.gaps = 0
+        self.dups = 0
+        self.held: Optional[tuple] = None  # (seq, frame, truncate) reorder hold
+        self.stall = 0  # frames still to absorb into the stall queue
+        self.stall_q: list = []
+        self.dial: Optional[Tuple[str, int]] = None
+        self.reconnecting = False
+        #: a conn that broke WHILE a reconnect was in flight; replayed
+        #: once the reconnect loop finishes so a failure of the
+        #: replacement link is never silently swallowed
+        self.pending_break: Optional["_Conn"] = None
+        #: the peer incarnation this stream state belongs to
+        self.nonce: Optional[int] = None
+
+
+class _Corrupt:
+    """Sentinel for a frame whose body failed to decode (truncated by
+    fault injection, or garbage on the wire)."""
+
+    __slots__ = ()
+
+
+_CORRUPT = _Corrupt()
+
+
+def _frame_bytes(frame: tuple, truncate: bool = False) -> bytes:
+    """The one length-prefixed framing implementation.  ``truncate``
+    (fault injection) cuts the body but keeps the prefix consistent, so
+    the stream survives and only this frame fails to decode."""
+    body = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
+    if truncate:
+        body = body[: max(8, len(body) // 2)]
+    return struct.pack(">I", len(body)) + body
+
+
 class _Conn:
     __slots__ = ("sock", "lock", "address")
 
@@ -134,11 +225,13 @@ class _Conn:
         self.address = address
 
     def send(self, frame: tuple) -> None:
-        buf = pickle.dumps(frame, protocol=pickle.HIGHEST_PROTOCOL)
-        with self.lock:
-            self.sock.sendall(struct.pack(">I", len(buf)) + buf)
+        self.send_bytes(_frame_bytes(frame))
 
-    def recv(self) -> Optional[tuple]:
+    def send_bytes(self, buf: bytes) -> None:
+        with self.lock:
+            self.sock.sendall(buf)
+
+    def recv(self):
         header = self._read_exact(4)
         if header is None:
             return None
@@ -146,7 +239,12 @@ class _Conn:
         body = self._read_exact(n)
         if body is None:
             return None
-        return pickle.loads(body)
+        try:
+            return pickle.loads(body)
+        except Exception:
+            # The framing is intact (we read exactly n bytes), only the
+            # body is damaged — drop the frame, keep the stream.
+            return _CORRUPT
 
     def _read_exact(self, n: int) -> Optional[bytes]:
         chunks = []
@@ -178,7 +276,7 @@ class NodeFabric:
 
     serialize = True  # read by engines that branch on the fabric mode
 
-    def __init__(self, address: str = ""):
+    def __init__(self, address: str = "", fault_plan: Optional[faults.FaultPlan] = None):
         #: canonical cluster address — MUST equal the hosted system's
         #: address (undo-log quorums compare ingress-entry addresses
         #: against membership addresses; one namespace, or quorums never
@@ -187,6 +285,7 @@ class NodeFabric:
         self.system: Optional["ActorSystem"] = None
         self.systems: Dict[str, Any] = {}
         self.crashed: set = set()
+        self.fault_plan = fault_plan
         self._subscribers: List["ActorCell"] = []
         self._lock = threading.Lock()
         self._names: Dict[str, Any] = {}
@@ -195,8 +294,19 @@ class NodeFabric:
         self._proxies: Dict[Tuple[str, int], ProxyCell] = {}
         self._out: Dict[str, _HalfLink] = {}
         self._in: Dict[str, _HalfLink] = {}
+        self._peers: Dict[str, _PeerState] = {}
         self._listener: Optional[socket.socket] = None
         self._closing = False
+        self._hb = None  # HeartbeatMonitor when enabled by config
+        self._reconnect_retries = 0
+        self._reconnect_backoff_s = 0.05
+        #: this process-incarnation's identity, exchanged in the hello:
+        #: a reconnect that reaches a RESTARTED peer (same address, new
+        #: process) must not resume the old frame stream — its sequence
+        #: numbers restart and every frame would be discarded as a
+        #: duplicate.  A nonce mismatch on reinstall means the old
+        #: incarnation died.
+        self._nonce = int.from_bytes(os.urandom(8), "big")
 
     # ------------------------------------------------------------- #
     # System + name registry
@@ -211,9 +321,33 @@ class NodeFabric:
         self.system = system
         self.address = system.address
         self.systems[system.address] = system
+        config = system.config
+        self._reconnect_retries = config.get_int("uigc.node.reconnect-retries")
+        self._reconnect_backoff_s = (
+            config.get_int("uigc.node.reconnect-backoff") / 1000.0
+        )
+        hb_ms = config.get_int("uigc.node.heartbeat-interval")
+        if hb_ms > 0:
+            from .heartbeat import HeartbeatMonitor
+
+            self._hb = HeartbeatMonitor(
+                hb_ms / 1000.0,
+                peers=self._live_peers,
+                ping=lambda address: self._send_frame(address, ("hb",)),
+                on_down=self._on_phi_down,
+                threshold=config.get_float("uigc.node.phi-threshold"),
+                acceptable_pause_s=config.get_int("uigc.node.heartbeat-pause")
+                / 1000.0,
+            )
+            self._hb.start()
 
     def unregister_system(self, system: "ActorSystem") -> None:
         self.close()
+
+    def set_fault_plan(self, plan: Optional[faults.FaultPlan]) -> None:
+        """Attach (or clear) the fault-injection policy consulted on
+        every frame edge of this node."""
+        self.fault_plan = plan
 
     def register_name(self, name: str, cell: Any) -> None:
         """Advertise a well-known local cell (exchanged in the hello
@@ -233,12 +367,17 @@ class NodeFabric:
 
     def resolve_cell_token(self, address: str, uid: int):
         """wire.py resolution hook: local uids resolve to real cells,
-        remote uids to cached proxies."""
+        remote uids to cached proxies.  A LOCAL uid that no longer
+        resolves (the actor terminated and was reclaimed) yields the
+        cached proxy as a *tombstone* instead of raising: every decoder
+        on this node (app frames, delta graphs, ingress-entry
+        rebroadcasts) then folds facts about the dead actor under one
+        stable key, which is what lets post-mortem claims and the
+        dead-letter tally cancel instead of stranding the frame."""
         if address == self.address:
             cell = self.system.resolve_cell(uid)
-            if cell is None:
-                raise LookupError(f"no cell uid={uid} in {address!r}")
-            return cell
+            if cell is not None:
+                return cell
         return self._proxy(address, uid)
 
     # ------------------------------------------------------------- #
@@ -248,7 +387,7 @@ class NodeFabric:
     def _hello(self) -> tuple:
         bk = self.system.engine.bookkeeper_cell
         names = {n: c.uid for n, c in self._names.items()}
-        return ("hello", self.address, names, bk.uid)
+        return ("hello", self.address, names, bk.uid, self._nonce)
 
     def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start accepting peer connections; returns the bound port."""
@@ -277,15 +416,30 @@ class NodeFabric:
 
     def connect(self, host: str, port: int) -> str:
         """Dial a peer; blocks until its hello arrives.  Returns the
-        peer's address."""
-        sock = socket.create_connection((host, port), timeout=30)
+        peer's address.  With ``uigc.node.reconnect-retries`` > 0 the
+        initial dial retries with exponential backoff too."""
+        attempts = 1 + self._reconnect_retries
+        for attempt in range(attempts):
+            try:
+                sock = socket.create_connection((host, port), timeout=30)
+                break
+            except OSError:
+                if attempt + 1 >= attempts:
+                    raise
+                time.sleep(self._reconnect_backoff_s * (2**attempt))
+        # The dial timeout must not outlive the dial: a lingering socket
+        # timeout would make recv() on an idle-but-healthy link raise
+        # after 30s and be mistaken for EOF.
+        sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = _Conn(sock)
         conn.send(self._hello())
         hello = conn.recv()
-        if hello is None or hello[0] != "hello":
+        if hello is None or hello is _CORRUPT or hello[0] != "hello":
             raise ConnectionError("peer handshake failed")
-        self._install_peer(conn, hello)
+        if not self._install_peer(conn, hello):
+            raise ConnectionError(f"peer {hello[1]!r} was already declared dead")
+        self._peer_state(conn.address).dial = (host, port)
         threading.Thread(
             target=self._recv_loop, args=(conn,), name="node-conn", daemon=True
         ).start()
@@ -293,51 +447,297 @@ class NodeFabric:
 
     def _serve_conn(self, conn: _Conn) -> None:
         hello = conn.recv()
-        if hello is None or hello[0] != "hello":
+        if hello is None or hello is _CORRUPT or hello[0] != "hello":
             conn.close()
             return
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn.send(self._hello())
-        self._install_peer(conn, hello)
+        if not self._install_peer(conn, hello):
+            conn.close()
+            return
         self._recv_loop(conn)
 
-    def _install_peer(self, conn: _Conn, hello: tuple) -> None:
-        _, address, names, bk_uid = hello
+    def _install_peer(self, conn: _Conn, hello: tuple) -> bool:
+        """Adopt a handshaken connection.  Returns False when the peer
+        was already declared dead (a removed member cannot silently
+        rejoin — recovery already reverted its effects) or when a known
+        address presents a NEW incarnation nonce (the old process died;
+        a restarted one may not resume its frame stream)."""
+        _, address, names, bk_uid, nonce = hello
         conn.address = address
+        st = self._peer_state(address)
         with self._lock:
-            self._conns[address] = conn
-            self._peer_names[address] = names
-            self.systems[address] = RemoteSystemStub(
-                address, self._proxy(address, bk_uid)
+            if address in self.crashed:
+                return False
+            known = address in self._conns
+            if known and st.nonce is not None and st.nonce != nonce:
+                restarted = True
+            else:
+                restarted = False
+                st.nonce = nonce
+                self._conns[address] = conn
+                self._peer_names[address] = names
+                self.systems[address] = RemoteSystemStub(
+                    address, self._proxy(address, bk_uid)
+                )
+            subscribers = list(self._subscribers) if not known else []
+        if restarted:
+            # The incarnation we were linked to is gone: run the death
+            # verdict for it, and refuse the newcomer like any rejoin.
+            self._declare_dead(address, "restart")
+            return False
+        if self._hb is not None:
+            self._hb.record(address)
+        if known:
+            events.recorder.commit(
+                events.LINK_RECONNECT, address=address, side="accept"
             )
-            subscribers = list(self._subscribers)
         for s in subscribers:
             s.tell(MemberUp(address))
+        return True
+
+    def _peer_state(self, address: str) -> _PeerState:
+        with self._lock:
+            st = self._peers.get(address)
+            if st is None:
+                st = self._peers[address] = _PeerState()
+            return st
+
+    def _live_peers(self) -> List[str]:
+        with self._lock:
+            return [a for a in self._conns if a not in self.crashed]
+
+    # ------------------------------------------------------------- #
+    # Frame transmission (seq layer + fault injection)
+    # ------------------------------------------------------------- #
+
+    def _send_frame(self, dst_address: str, inner: tuple, conn: Optional[_Conn] = None) -> bool:
+        """Transmit one frame on the link to ``dst_address`` through the
+        sequence layer and the fault plan.  Every verdict — including a
+        drop — consumes a sequence number, so the receiver can tell
+        "lost in flight" (gap) from "never sent"."""
+        if conn is None:
+            conn = self._conn_for(dst_address)
+        if conn is None:
+            return False
+        st = self._peer_state(dst_address)
+        plan = self.fault_plan
+        kind = inner[0]
+        broken = False
+        with st.lock:
+            if plan is None:
+                action, frames = faults.DELIVER, 0
+            else:
+                action, frames = plan.outbound(self.address, dst_address, kind)
+            st.seq_out += 1
+            seq = st.seq_out
+            transmit: list = []
+            if action == faults.DROP:
+                events.recorder.commit(
+                    events.FRAME_DROPPED,
+                    src=self.address,
+                    dst=dst_address,
+                    kind=kind,
+                )
+            elif action == faults.DUPLICATE:
+                transmit = [(seq, inner, False), (seq, inner, False)]
+            elif action == faults.TRUNCATE:
+                transmit = [(seq, inner, True)]
+            elif action == faults.REORDER and st.held is None:
+                st.held = (seq, inner, False)
+            elif action == faults.DELAY:
+                st.stall = max(st.stall, frames)
+                st.stall_q.append((seq, inner, False))
+            else:
+                transmit = [(seq, inner, False)]
+
+            if transmit and st.stall > 0:
+                # Link stalled: absorb in order, release when drained.
+                st.stall_q.extend(transmit)
+                st.stall -= 1
+                transmit = []
+                if st.stall <= 0:
+                    transmit = st.stall_q
+                    st.stall_q = []
+            if transmit and st.held is not None:
+                # Release the reordered frame AFTER the newer one(s) —
+                # including a stall-queue drain, so combining delay and
+                # reorder rules cannot strand the held frame while
+                # traffic continues.  (A held or stalled frame on a link
+                # that goes PERMANENTLY quiet is never transmitted; that
+                # is the documented fault model — it becomes a drop.)
+                transmit = transmit + [st.held]
+                st.held = None
+
+            for sq, fr, trunc in transmit:
+                try:
+                    conn.send_bytes(_frame_bytes(("f", sq, fr), trunc))
+                except OSError:
+                    broken = True
+                    break
+        crash = plan is not None and plan.record_sent(self.address, kind)
+        if broken:
+            self._on_conn_broken(dst_address, conn)
+        if crash:
+            self.die(reason="fault-plan")
+            return False
+        return not broken
+
+    # ------------------------------------------------------------- #
+    # Receive path
+    # ------------------------------------------------------------- #
 
     def _recv_loop(self, conn: _Conn) -> None:
         while True:
             frame = conn.recv()
             if frame is None:
                 break
+            if self._hb is not None and conn.address:
+                self._hb.record(conn.address)
+            if frame is _CORRUPT:
+                events.recorder.commit(events.FRAME_CORRUPT, src=conn.address)
+                continue
+            if frame[0] == "f":
+                _, seq, inner = frame
+                st = self._peer_state(conn.address)
+                with st.rlock:
+                    if seq <= st.seq_in:
+                        st.dups += 1
+                        dup = True
+                    else:
+                        dup = False
+                        if seq > st.seq_in + 1:
+                            st.gaps += seq - st.seq_in - 1
+                            events.recorder.commit(
+                                events.FRAME_GAP,
+                                src=conn.address,
+                                missed=seq - st.seq_in - 1,
+                            )
+                        st.seq_in = seq
+                if dup:
+                    events.recorder.commit(
+                        events.FRAME_DUPLICATE, src=conn.address, seq=seq
+                    )
+                    continue
+                if inner[0] == "hb":
+                    continue
+            else:  # pre-seq-layer frame (a stray hello): ignore
+                continue
             try:
-                self._on_frame(conn.address, frame)
+                self._on_frame(conn.address, inner)
             except Exception:  # pragma: no cover - keep the link alive
-                import traceback
-
                 traceback.print_exc()
-        self._on_disconnect(conn.address)
+        self._on_conn_broken(conn.address, conn)
 
-    def _on_disconnect(self, address: str) -> None:
-        """EOF from a peer = the member died (or left): kill -9 of the
-        peer process lands here, after everything it managed to send was
-        delivered in order."""
+    def _on_conn_broken(self, address: str, conn: Optional[_Conn]) -> None:
+        """A connection died (EOF or send failure).  With reconnects
+        enabled, try to heal the link before declaring the member dead;
+        the dialer side re-dials, the acceptor side waits out the same
+        window for a fresh hello."""
+        if self._closing or not address:
+            return
+        with self._lock:
+            if address in self.crashed or address not in self._conns:
+                return
+            if conn is not None and self._conns.get(address) is not conn:
+                return  # already replaced by a reconnect
+        st = self._peer_state(address)
+        if self._reconnect_retries > 0:
+            with st.rlock:
+                if st.reconnecting:
+                    # A break during an in-flight reconnect (e.g. the
+                    # replacement conn died): remember it and let the
+                    # running loop's epilogue replay it.
+                    st.pending_break = conn
+                    return
+                st.reconnecting = True
+            threading.Thread(
+                target=self._reconnect_loop,
+                args=(address, st, conn),
+                name="node-reconnect",
+                daemon=True,
+            ).start()
+            return
+        self._declare_dead(address, "eof")
+
+    def _reconnect_loop(self, address: str, st: _PeerState, old_conn: Optional[_Conn]) -> None:
+        try:
+            for attempt in range(self._reconnect_retries):
+                time.sleep(self._reconnect_backoff_s * (2**attempt))
+                if self._closing:
+                    return
+                with self._lock:
+                    if address in self.crashed:
+                        return
+                    if self._conns.get(address) is not old_conn:
+                        return  # the peer re-dialed us meanwhile
+                if st.dial is None:
+                    continue  # acceptor side: keep waiting the window out
+                try:
+                    sock = socket.create_connection(st.dial, timeout=5)
+                    sock.settimeout(None)  # dial timeout only, see connect()
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    conn = _Conn(sock)
+                    conn.send(self._hello())
+                    hello = conn.recv()
+                except OSError:
+                    continue
+                if hello is None or hello is _CORRUPT or hello[0] != "hello":
+                    conn.close()
+                    continue
+                if not self._install_peer(conn, hello):
+                    conn.close()
+                    return
+                events.recorder.commit(
+                    events.LINK_RECONNECT,
+                    address=address,
+                    attempts=attempt + 1,
+                    side="dial",
+                )
+                threading.Thread(
+                    target=self._recv_loop,
+                    args=(conn,),
+                    name="node-conn",
+                    daemon=True,
+                ).start()
+                return
+            with self._lock:
+                if self._conns.get(address) is not old_conn:
+                    return
+            self._declare_dead(address, "eof")
+        finally:
+            with st.rlock:
+                st.reconnecting = False
+                pending = st.pending_break
+                st.pending_break = None
+            if pending is not None and pending is not old_conn:
+                # The replacement link broke while we were busy: handle
+                # that break now (fresh reconnect round or death verdict).
+                self._on_conn_broken(address, pending)
+
+    def _on_phi_down(self, address: str, phi: float) -> None:
+        self._declare_dead(address, "heartbeat", phi=phi)
+
+    def _declare_dead(self, address: str, reason: str, **fields: Any) -> None:
+        """Terminal failure verdict for a peer: close its link, notify
+        subscribers (``kill -9`` of the peer process lands here through
+        EOF; a silent peer through the heartbeat monitor — after
+        everything it managed to send was delivered in order)."""
         if self._closing or not address:
             return
         with self._lock:
             if address in self.crashed or address not in self._conns:
                 return
             self.crashed.add(address)
+            conn = self._conns.get(address)
             subscribers = list(self._subscribers)
+        events.recorder.commit(
+            events.NODE_DOWN, address=address, reason=reason, **fields
+        )
+        if self._hb is not None:
+            self._hb.forget(address)
+        if conn is not None:
+            conn.close()
         for s in subscribers:
             s.tell(MemberRemoved(address))
 
@@ -397,7 +797,9 @@ class NodeFabric:
         """Fault injection at the receiving edge: fn(msg) -> True drops
         the message after decode, before the ingress tally (the same
         observable semantics as the in-process fabric's drop filter —
-        the bytes 'arrived' but were never admitted)."""
+        the bytes 'arrived' but were never admitted).  Prefer a
+        ``FaultPlan`` with ``drop_messages`` for new code; this remains
+        as the minimal single-link hook."""
         self._in_link(src_address).drop_filter = fn
 
     # ------------------------------------------------------------- #
@@ -420,10 +822,7 @@ class NodeFabric:
             if link.egress is not None:
                 link.egress.on_message(target, msg)
             payload = wire.encode_message(msg)
-            try:
-                conn.send(("app", target.uid, payload))
-            except OSError:
-                self._on_disconnect(dst_address)
+            self._send_frame(dst_address, ("app", target.uid, payload), conn)
 
     def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
         conn = self._conn_for(dst_address)
@@ -434,10 +833,7 @@ class NodeFabric:
             if link.egress is None:
                 return
             marker = link.egress.finalize_entry()
-            try:
-                conn.send(("marker", marker.id))
-            except OSError:
-                self._on_disconnect(dst_address)
+            self._send_frame(dst_address, ("marker", marker.id), conn)
 
     def finalize_dead_link(self, src_address: str, dst: "ActorSystem") -> None:
         with self._lock:
@@ -446,6 +842,9 @@ class NodeFabric:
             return
         with link.recv_lock:
             link.ingress.finalize_all(is_final=True)
+        events.recorder.commit(
+            events.DEAD_LINK_FINALIZED, src=src_address, dst=self.address
+        )
 
     def control_send(self, src: "ActorSystem", target_cell: Any, msg: Any) -> None:
         """Collector gossip: reliable, typed wire formats
@@ -459,17 +858,13 @@ class NodeFabric:
         conn = self._conn_for(dst_address)
         if conn is None:
             return
-        try:
-            if isinstance(msg, DeltaMsg):
-                conn.send(
-                    ("delta", msg.seqnum, msg.graph.serialize(wire.encode_cell))
-                )
-            elif isinstance(msg, RemoteIngressEntry):
-                conn.send(("ringress", msg.entry.serialize(wire.encode_cell)))
-            else:
-                conn.send(("ctrl", wire.encode_message(msg)))
-        except OSError:
-            self._on_disconnect(dst_address)
+        if isinstance(msg, DeltaMsg):
+            frame = ("delta", msg.seqnum, msg.graph.serialize(wire.encode_cell))
+        elif isinstance(msg, RemoteIngressEntry):
+            frame = ("ringress", msg.entry.serialize(wire.encode_cell))
+        else:
+            frame = ("ctrl", wire.encode_message(msg))
+        self._send_frame(dst_address, frame, conn)
 
     # ------------------------------------------------------------- #
     # Frame dispatch (receiver side)
@@ -479,13 +874,38 @@ class NodeFabric:
         kind = frame[0]
         if kind == "app":
             _, uid, payload = frame
-            cell = self.system.resolve_cell(uid)
             msg = wire.decode_message(self, payload)
             link = self._in_link(from_address)
             if link.drop_filter is not None and link.drop_filter(msg):
                 return
+            if self.fault_plan is not None and self.fault_plan.drop_inbound(
+                from_address, self.address, msg
+            ):
+                events.recorder.commit(
+                    events.FRAME_DROPPED,
+                    src=from_address,
+                    dst=self.address,
+                    kind="app",
+                )
+                return
+            cell = self.system.resolve_cell(uid)
             if cell is None:
-                self.system.record_dead_letters_dropped(None, 1)
+                # Post-mortem frame: the recipient terminated and was
+                # reclaimed.  The sender's egress already stamped this
+                # send into a window, so it MUST still tally on the
+                # ingress (keyed by the stable tombstone proxy) or the
+                # link's recv balance never returns to zero after the
+                # sender dies; and the refs the message carries must be
+                # released or their targets leak across processes.
+                # record_dead_letter routes through the engine's
+                # dead-letter accounting (CRGC.on_dead_letter).
+                tombstone = self._proxy(self.address, uid)
+                with link.recv_lock:
+                    if link.ingress is not None:
+                        link.ingress.on_message(tombstone, msg)
+                # record_dead_letter emits the fabric.dead_letter event
+                # (the tombstone's path carries the origin uid).
+                self.system.record_dead_letter(tombstone, msg)
                 return
             with link.recv_lock:
                 if link.ingress is not None:
@@ -519,8 +939,29 @@ class NodeFabric:
 
     # ------------------------------------------------------------- #
 
+    def die(self, reason: str = "injected") -> None:
+        """Abrupt self-crash (fault injection): the engine stops acting
+        immediately and every socket closes with only what the kernel
+        already accepted — ``kill -9`` semantics without losing the
+        process, so a test can still inspect the corpse.  Peers observe
+        EOF (or heartbeat silence, if the plan muted the links first)."""
+        if self._closing:
+            return
+        self._closing = True  # suppress break handling during teardown
+        events.recorder.commit(
+            events.NODE_CRASHED, address=self.address, reason=reason
+        )
+        try:
+            if self.system is not None:
+                self.system.engine.on_crash()
+        except Exception:  # pragma: no cover - death must not raise
+            traceback.print_exc()
+        self.close()
+
     def close(self) -> None:
         self._closing = True
+        if self._hb is not None:
+            self._hb.stop()
         if self._listener is not None:
             try:
                 self._listener.close()

@@ -14,6 +14,7 @@ import weakref
 from typing import Any, Dict, Mapping, Optional
 
 from ..config import Config
+from ..utils import events
 from .behaviors import ActorFactory, RawBehavior
 from .cell import ActorCell
 from .context import ActorContext
@@ -202,12 +203,24 @@ class ActorSystem:
             return cell
 
     def record_dead_letter(self, cell: ActorCell, msg: Any) -> None:
+        """Route one undeliverable message through the engine's
+        dead-letter accounting.  ``cell`` may be a live-but-terminated
+        ActorCell or a remote/tombstone proxy (runtime/node.py routes
+        post-mortem frames here keyed by the uid's cached proxy)."""
         self.dead_letters += 1
+        events.recorder.commit(
+            events.DEAD_LETTER,
+            address=self.address,
+            path=getattr(cell, "path", "?"),
+        )
         engine = getattr(self, "engine", None)
         if engine is not None:
             engine.on_dead_letter(cell, msg)
 
     def record_dead_letters_dropped(self, cell: ActorCell, count: int) -> None:
+        """Count messages that were dropped without individual
+        accounting (e.g. a stopping actor draining its own mailbox —
+        the engine already folded their effects in bulk)."""
         self.dead_letters += count
 
     @property

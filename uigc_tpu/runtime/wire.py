@@ -108,7 +108,21 @@ class _Unpickler(pickle.Unpickler):
 
             return CrgcRefob(cell)
         if kind == "ref":
-            return cell.system.engine.to_root_refob(cell)
+            # Engine-agnostic refs re-materialize through an engine's
+            # root conversion.  On a cross-process fabric the resolved
+            # cell can be a ProxyCell whose ProxySystem has no engine —
+            # wrap through the LOCAL system's engine instead (it is the
+            # one that will manage the ref from here on).
+            engine = getattr(cell.system, "engine", None)
+            if engine is None or not hasattr(engine, "to_root_refob"):
+                local = getattr(self._fabric, "system", None)
+                if local is None:
+                    raise LookupError(
+                        f"cannot materialize generic ref to {address}/{uid}: "
+                        "no local engine on this fabric"
+                    )
+                engine = local.engine
+            return engine.to_root_refob(cell)
         if kind == "rawref":
             from .system import RawRef
 

@@ -34,6 +34,36 @@ ACTOR_BLOCKED = "mac.actor_blocked"
 PROCESSING_MESSAGES = "mac.processing_messages"
 DEVICE_TRACE = "tpu.device_trace"  # ours: one device kernel dispatch
 
+# Transport/failure events (ours; the reference has no failure-injection
+# instrumentation).  Emitted by runtime/node.py, runtime/fabric.py,
+# runtime/heartbeat.py and the CRGC crash-accounting paths, so a test or
+# chaos bench can observe detection and recovery without touching
+# internals:
+#   fabric.node_suspect     phi crossed half the threshold (early warning)
+#   fabric.node_down        failure verdict; fields: address, reason
+#                           ("heartbeat" | "eof" | "injected")
+#   fabric.node_crashed     this node crash-injected itself (FaultPlan)
+#   fabric.link_reconnect   a broken link was re-dialed successfully
+#   fabric.dead_link_finalized  finalize_dead_link flushed the ingress
+#   fabric.dead_letter      undeliverable frame routed through the
+#                           dead-letter accounting (recipient gone)
+#   fabric.frame_dropped    fault injection dropped an outbound frame
+#   fabric.frame_duplicate  receiver seq layer discarded a duplicate
+#   fabric.frame_gap        receiver seq layer observed missing frames
+#   fabric.frame_corrupt    frame body failed to decode (truncation)
+#   crgc.undo_fold          a dead node's undo log folded into the graph
+NODE_SUSPECT = "fabric.node_suspect"
+NODE_DOWN = "fabric.node_down"
+NODE_CRASHED = "fabric.node_crashed"
+LINK_RECONNECT = "fabric.link_reconnect"
+DEAD_LINK_FINALIZED = "fabric.dead_link_finalized"
+DEAD_LETTER = "fabric.dead_letter"
+FRAME_DROPPED = "fabric.frame_dropped"
+FRAME_DUPLICATE = "fabric.frame_duplicate"
+FRAME_GAP = "fabric.frame_gap"
+FRAME_CORRUPT = "fabric.frame_corrupt"
+UNDO_FOLD = "crgc.undo_fold"
+
 
 class EventRecorder:
     """Thread-safe counter/duration sink with optional listeners."""
@@ -55,6 +85,11 @@ class EventRecorder:
     def add_listener(self, fn: Callable[[str, Dict[str, Any]], None]) -> None:
         with self._lock:
             self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[str, Dict[str, Any]], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def commit(self, name: str, duration_s: Optional[float] = None, **fields: Any) -> None:
         """Record one event occurrence (the JFR ``commit()`` analogue)."""
