@@ -1,0 +1,575 @@
+#!/usr/bin/env python
+"""uigc-lint: AST-based static checks for actor code and the runtime.
+
+Catches the protocol mistakes that produce silent GC unsoundness or
+scheduling hangs — the static half of the correctness tooling whose
+online half is ``uigc_tpu/analysis`` (uigcsan).  Runs on the repo
+itself (``python tools/uigc_lint.py --strict uigc_tpu/``) and on user
+actor code.
+
+Rules
+=====
+
+UL001  ref-captured-in-closure
+    A name that looks like an actor ref (``*ref*``) is captured by a
+    closure passed to ``Behaviors.setup``/``spawn`` inside a behavior
+    method, with no ``create_ref`` call in the enclosing function.
+    Handing a refob to another actor without registering it with
+    ``context.create_ref`` breaks CRGC's created/released pairing: the
+    collector never learns the new owner and may collect a live actor.
+
+UL002  message-refs-not-exported
+    A class deriving ``Message`` stores constructor parameters that
+    look like refs but its ``refs`` property returns a constant empty
+    tuple (or the class derives ``NoRefs`` while storing refs).
+    Refs that ride a message invisibly are not counted at the ingress
+    and leak (or over-collect) across nodes.
+
+UL003  blocking-call-in-behavior
+    A blocking call (``time.sleep``, ``socket.recv``, ``.join()``,
+    ``queue.get``, ``Event.wait``, ``input``) inside a behavior
+    callback (``on_message``/``on_signal`` or a ``Behaviors.setup``
+    closure).  Behavior callbacks run on the shared dispatcher pool; a
+    blocked callback starves every other actor on that thread.
+
+UL004  bare-assert-invariant
+    A bare ``assert`` guarding a runtime invariant in library code.
+    Asserts are stripped under ``python -O``; invariants must raise
+    structured errors (``uigc_tpu/utils/validation.py``) that carry the
+    mismatching entries.  (Asserts in ``tests/`` are fine and not
+    linted.)
+
+UL005  inconsistent-lock-order
+    Two locks are acquired in opposite nesting orders somewhere across
+    the analyzed files (``with a_lock: ... with b_lock:`` here,
+    ``with b_lock: ... with a_lock:`` there) — the classic deadlock
+    shape.  Lock identity is approximated by attribute name, so locks
+    sharing a name across unrelated classes can alias; suppress a
+    false pair with the comment syntax below.
+
+Suppression
+===========
+
+Append ``# uigc-lint: disable=UL001`` (comma-separate several codes,
+or ``disable=all``) to the offending line.  Legacy violations are
+grandfathered in an allowlist file (default: ``uigc_lint_allow.txt``
+next to this script) of ``path:RULE:count`` budget lines — ``--strict``
+fails only on violations beyond the budget, so new code stays clean
+while old debt is burned down deliberately.
+
+Exit status: 0 when clean, within budget, or running advisory (no
+``--strict``); 1 on new violations under ``--strict``; 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+import tokenize
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+RULES = {
+    "UL001": "ref captured in closure without create_ref registration",
+    "UL002": "message stores refs its refs property does not export",
+    "UL003": "blocking call inside a behavior callback",
+    "UL004": "bare assert used for a runtime invariant in library code",
+    "UL005": "inconsistent lock-acquisition order",
+}
+
+_REF_NAME = re.compile(r"(^|_)refs?($|_)|refob", re.IGNORECASE)
+_LOCK_NAME = re.compile(r"(^|_)(lock|rlock|cv|cond)$", re.IGNORECASE)
+_SUPPRESS = re.compile(r"#\s*uigc-lint:\s*disable=([A-Za-z0-9,\s]+)")
+
+#: (module-or-attr, callable) shapes considered blocking in a callback.
+_BLOCKING_CALLS = {
+    ("time", "sleep"),
+    ("socket", "recv"),
+    ("socket", "accept"),
+    ("queue", "get"),
+    ("subprocess", "run"),
+    ("subprocess", "check_output"),
+}
+_BLOCKING_METHODS = {"join", "wait", "acquire", "recv", "accept", "get"}
+#: methods exempt because they are not the threading kind of wait/get
+_NONBLOCKING_HINTS = {"get"}  # dict.get — exempt unless a timeout arg is used
+_BLOCKING_BARE = {"input"}
+
+
+class Violation:
+    __slots__ = ("path", "line", "rule", "message")
+
+    def __init__(self, path: str, line: int, rule: str, message: str):
+        self.path = path
+        self.line = line
+        self.rule = rule
+        self.message = message
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """Map line -> set of rule codes disabled on that line."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                match = _SUPPRESS.search(tok.string)
+                if match:
+                    codes = {
+                        c.strip().upper()
+                        for c in match.group(1).split(",")
+                        if c.strip()
+                    }
+                    out[tok.start[0]] = codes
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def _call_name(node: ast.Call) -> Tuple[Optional[str], str]:
+    """(qualifier, name) of a call: foo.bar(...) -> ("foo", "bar")."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        if isinstance(base, ast.Name):
+            return base.id, fn.attr
+        return None, fn.attr
+    if isinstance(fn, ast.Name):
+        return None, fn.id
+    return None, ""
+
+
+def _contains_call(tree: ast.AST, name: str) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_name(node)[1] == name:
+            return True
+    return False
+
+
+def _is_behavior_class(node: ast.ClassDef) -> bool:
+    """A class with behavior callbacks (AbstractBehavior/RawBehavior
+    subclasses and duck-typed equivalents)."""
+    for item in node.body:
+        if isinstance(item, ast.FunctionDef) and item.name in (
+            "on_message",
+            "on_signal",
+        ):
+            return True
+    return False
+
+
+class _FileLinter:
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.violations: List[Violation] = []
+        #: (outer_lock, inner_lock) -> first line observed, for UL005
+        self.lock_pairs: Dict[Tuple[str, str], int] = {}
+        self._suppressed = _suppressed_lines(source)
+
+    def add(self, line: int, rule: str, message: str) -> None:
+        codes = self._suppressed.get(line, ())
+        if rule in codes or "ALL" in codes:
+            return
+        self.violations.append(Violation(self.path, line, rule, message))
+
+    # -- rules ------------------------------------------------------- #
+
+    def run(self, lint_asserts: bool) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._lint_class(node)
+        if lint_asserts:
+            self._lint_asserts()
+        self._collect_lock_pairs()
+
+    def _lint_class(self, cls: ast.ClassDef) -> None:
+        bases = {
+            b.id if isinstance(b, ast.Name) else getattr(b, "attr", "")
+            for b in cls.bases
+        }
+        if "Message" in bases or "NoRefs" in bases:
+            self._lint_message_class(cls, bases)
+        if _is_behavior_class(cls):
+            for item in cls.body:
+                if isinstance(item, ast.FunctionDef):
+                    if item.name in ("on_message", "on_signal", "__init__"):
+                        self._lint_behavior_callback(item)
+
+    def _lint_message_class(self, cls: ast.ClassDef, bases: Set[str]) -> None:
+        """UL002: stored ref-like constructor params vs the refs export."""
+        init = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+            ),
+            None,
+        )
+        if init is None:
+            return
+        stored_refs: List[Tuple[str, int]] = []
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                        and _REF_NAME.search(target.attr)
+                    ):
+                        stored_refs.append((target.attr, node.lineno))
+        if not stored_refs:
+            return
+        refs_prop = next(
+            (
+                n
+                for n in cls.body
+                if isinstance(n, ast.FunctionDef) and n.name == "refs"
+            ),
+            None,
+        )
+        if "NoRefs" in bases:
+            attr, line = stored_refs[0]
+            self.add(
+                line,
+                "UL002",
+                f"class {cls.name} derives NoRefs but stores ref-like "
+                f"attribute {attr!r}; derive Message and export it via refs",
+            )
+            return
+        if refs_prop is None:
+            attr, line = stored_refs[0]
+            self.add(
+                cls.lineno,
+                "UL002",
+                f"class {cls.name} stores ref-like attribute {attr!r} but "
+                "defines no refs property",
+            )
+            return
+        # refs property returning a constant empty tuple while refs are
+        # stored: the classic silent leak.
+        returns = [
+            n for n in ast.walk(refs_prop) if isinstance(n, ast.Return)
+        ]
+        if returns and all(
+            isinstance(r.value, ast.Tuple) and not r.value.elts
+            for r in returns
+            if r.value is not None
+        ):
+            attr, line = stored_refs[0]
+            self.add(
+                refs_prop.lineno,
+                "UL002",
+                f"class {cls.name} stores ref-like attribute {attr!r} but "
+                "its refs property always returns ()",
+            )
+
+    def _lint_behavior_callback(self, fn: ast.FunctionDef) -> None:
+        """UL001 + UL003 inside one behavior callback."""
+        has_create_ref = _contains_call(fn, "create_ref")
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                self._check_blocking(node)
+                qual, name = _call_name(node)
+                if name in ("setup", "setup_root", "spawn", "spawn_anonymous"):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Lambda):
+                            self._check_closure_capture(
+                                fn, node, arg, has_create_ref
+                            )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not fn:
+                    self._check_nested_def_capture(fn, node, has_create_ref)
+
+    def _closure_captured_refs(
+        self, fn: ast.FunctionDef, closure: ast.AST
+    ) -> List[str]:
+        """Ref-like names used inside ``closure`` but bound outside it."""
+        if isinstance(closure, ast.Lambda):
+            params = {a.arg for a in closure.args.args}
+            body = closure.body
+        elif isinstance(closure, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in closure.args.args}
+            body = ast.Module(body=closure.body, type_ignores=[])
+        else:
+            return []
+        captured = []
+        for node in ast.walk(body):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id not in params
+                and _REF_NAME.search(node.id)
+            ):
+                captured.append(node.id)
+            elif (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and _REF_NAME.search(node.attr)
+            ):
+                captured.append(f"self.{node.attr}")
+        return captured
+
+    def _check_closure_capture(
+        self,
+        fn: ast.FunctionDef,
+        call: ast.Call,
+        closure: ast.AST,
+        has_create_ref: bool,
+    ) -> None:
+        if has_create_ref:
+            return
+        captured = self._closure_captured_refs(fn, closure)
+        if captured:
+            self.add(
+                call.lineno,
+                "UL001",
+                f"closure passed to {_call_name(call)[1]} captures "
+                f"{sorted(set(captured))} without a create_ref registration "
+                f"in {fn.name}",
+            )
+
+    def _check_nested_def_capture(
+        self, fn: ast.FunctionDef, nested: ast.AST, has_create_ref: bool
+    ) -> None:
+        if has_create_ref:
+            return
+        captured = self._closure_captured_refs(fn, nested)
+        if captured:
+            self.add(
+                nested.lineno,
+                "UL001",
+                f"nested function {nested.name!r} captures "
+                f"{sorted(set(captured))} without a create_ref registration "
+                f"in {fn.name}",
+            )
+
+    def _check_blocking(self, call: ast.Call) -> None:
+        qual, name = _call_name(call)
+        line = call.lineno
+        if name in _BLOCKING_BARE and qual is None:
+            self.add(line, "UL003", f"blocking call {name}() in a behavior callback")
+            return
+        if qual is not None and (qual, name) in _BLOCKING_CALLS:
+            self.add(
+                line, "UL003", f"blocking call {qual}.{name}() in a behavior callback"
+            )
+            return
+        if qual is not None and name in _BLOCKING_METHODS:
+            if name in _NONBLOCKING_HINTS and not call.args and not call.keywords:
+                return
+            # Attribute-based heuristic: obj.join()/obj.wait()/... on
+            # thread/queue/event-like receivers.
+            if re.search(
+                r"thread|queue|event|cond|proc|sock|future|lock",
+                qual,
+                re.IGNORECASE,
+            ):
+                self.add(
+                    line,
+                    "UL003",
+                    f"blocking call {qual}.{name}() in a behavior callback",
+                )
+
+    def _lint_asserts(self) -> None:
+        """UL004: bare asserts in library code."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assert):
+                self.add(
+                    node.lineno,
+                    "UL004",
+                    "bare assert is stripped under python -O; raise a "
+                    "structured error from uigc_tpu.utils.validation instead",
+                )
+
+    def _collect_lock_pairs(self) -> None:
+        """Record nested with-lock orders for the cross-file UL005 pass."""
+
+        def lock_attr(expr: ast.AST) -> Optional[str]:
+            # with self._lock: / with link.recv_lock: / with st.rlock:
+            if isinstance(expr, ast.Attribute) and _LOCK_NAME.search(expr.attr):
+                return expr.attr
+            if isinstance(expr, ast.Name) and _LOCK_NAME.search(expr.id):
+                return expr.id
+            return None
+
+        def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.With):
+                    acquired = []
+                    for item in child.items:
+                        name = lock_attr(item.context_expr)
+                        if name is not None:
+                            acquired.append(name)
+                    for outer in held:
+                        for inner in acquired:
+                            if outer != inner:
+                                self.lock_pairs.setdefault(
+                                    (outer, inner), child.lineno
+                                )
+                    walk(child, held + tuple(acquired))
+                else:
+                    walk(child, held)
+
+        walk(self.tree, ())
+
+
+def _load_allowlist(path: Optional[str]) -> Dict[Tuple[str, str], int]:
+    budget: Dict[Tuple[str, str], int] = {}
+    if path is None or not os.path.exists(path):
+        return budget
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                file_part, rule, count = line.rsplit(":", 2)
+                budget[(file_part, rule.upper())] = int(count)
+            except ValueError:
+                print(f"uigc-lint: bad allowlist line: {line!r}", file=sys.stderr)
+    return budget
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for path in paths:
+        if os.path.isfile(path) and path.endswith(".py"):
+            out.append(path)
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [d for d in dirs if not d.startswith((".", "__pycache__"))]
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+def lint_paths(
+    paths: Iterable[str],
+    lint_asserts: bool = True,
+) -> List[Violation]:
+    violations: List[Violation] = []
+    all_lock_pairs: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            violations.append(Violation(path, 1, "UL000", f"unparseable: {exc}"))
+            continue
+        linter = _FileLinter(path, source, tree)
+        # Library code gets the assert rule; test trees keep asserts.
+        in_tests = "tests" in path.split(os.sep)
+        linter.run(lint_asserts=lint_asserts and not in_tests)
+        violations.extend(linter.violations)
+        for pair, line in linter.lock_pairs.items():
+            all_lock_pairs.setdefault(pair, (path, line))
+    # UL005: cross-file order cycle detection over the lock-name digraph.
+    for (outer, inner), (path, line) in sorted(all_lock_pairs.items()):
+        reverse = all_lock_pairs.get((inner, outer))
+        if reverse is not None and (outer, inner) < (inner, outer):
+            rpath, rline = reverse
+            violations.append(
+                Violation(
+                    path,
+                    line,
+                    "UL005",
+                    f"locks {outer!r} then {inner!r} here, but "
+                    f"{inner!r} then {outer!r} at {rpath}:{rline}",
+                )
+            )
+    return violations
+
+
+def apply_allowlist(
+    violations: List[Violation], budget: Dict[Tuple[str, str], int]
+) -> Tuple[List[Violation], List[Violation]]:
+    """Split violations into (grandfathered, new) against per-file
+    per-rule budgets.  Budget paths match exactly or as a path suffix,
+    so relative allowlist entries cover absolute lint invocations."""
+
+    def budget_key(path: str, rule: str) -> Optional[Tuple[str, str]]:
+        path = path.replace(os.sep, "/")
+        if (path, rule) in budget:
+            return (path, rule)
+        for (allowed, allowed_rule) in budget:
+            if allowed_rule == rule and path.endswith("/" + allowed):
+                return (allowed, allowed_rule)
+        return None
+
+    counts: Dict[Tuple[str, str], int] = defaultdict(int)
+    grandfathered: List[Violation] = []
+    fresh: List[Violation] = []
+    for v in violations:
+        key = budget_key(v.path, v.rule)
+        if key is None:
+            fresh.append(v)
+            continue
+        counts[key] += 1
+        if counts[key] <= budget[key]:
+            grandfathered.append(v)
+        else:
+            fresh.append(v)
+    return grandfathered, fresh
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="uigc-lint", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("paths", nargs="+", help="files or directories to lint")
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit nonzero on violations beyond the allowlist budget "
+        "(the default run is advisory: report, exit 0)",
+    )
+    parser.add_argument(
+        "--allowlist",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)), "uigc_lint_allow.txt"),
+        help="path:RULE:count budget file (default: uigc_lint_allow.txt next to this script)",
+    )
+    parser.add_argument(
+        "--no-allowlist", action="store_true", help="ignore the allowlist"
+    )
+    parser.add_argument(
+        "--select",
+        default="",
+        help="comma-separated rule codes to report (default: all)",
+    )
+    args = parser.parse_args(argv)
+
+    violations = lint_paths(args.paths)
+    if args.select:
+        wanted = {c.strip().upper() for c in args.select.split(",")}
+        violations = [v for v in violations if v.rule in wanted]
+    budget = {} if args.no_allowlist else _load_allowlist(args.allowlist)
+    grandfathered, fresh = apply_allowlist(violations, budget)
+
+    for v in fresh:
+        print(v.render())
+    if grandfathered:
+        print(
+            f"uigc-lint: {len(grandfathered)} grandfathered violation(s) "
+            f"suppressed by allowlist",
+            file=sys.stderr,
+        )
+    if fresh:
+        print(f"uigc-lint: {len(fresh)} new violation(s)", file=sys.stderr)
+        return 1 if args.strict else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

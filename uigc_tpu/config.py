@@ -96,6 +96,26 @@ DEFAULTS: Dict[str, Any] = {
     # Milliseconds of backoff before the first reconnect attempt,
     # doubled per attempt.
     "uigc.node.reconnect-backoff": 50,
+    # --- Correctness tooling (uigc_tpu/analysis; no reference analogue,
+    # the reference debugged with in-source asserts) ---
+    # Attach the uigcsan online sanitizer at system creation: a shadow
+    # oracle re-derives every collection verdict and cross-checks the
+    # engine's quiescence decisions, balances and fold discipline
+    # (analysis/sanitizer.py).  Costly; meant for tests and debugging.
+    "uigc.analysis.sanitizer": False,
+    # Raise SanitizerViolation at the point of detection instead of only
+    # recording it.  Fail-fast debugging mode: a raise from an engine
+    # hook or the collector fold propagates into the cell batch, where
+    # default supervision prints the traceback and STOPS that actor
+    # (for collector-side checks, the Bookkeeper — halting GC); a raise
+    # from a stop-decision tap is printed and the stop proceeds.  The
+    # violation is always recorded on system.sanitizer and emitted as an
+    # ``analysis.violation`` event first, so no evidence is lost.
+    "uigc.analysis.sanitizer-raise": False,
+    # Emit ``sched.*`` scheduling events from the cell/dispatcher layer
+    # (consumed by the vector-clock race detector, analysis/race.py).
+    # Requires the event recorder to be enabled as well.
+    "uigc.analysis.sched-events": False,
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,

@@ -180,7 +180,16 @@ class DeltaGraph:
         for shadow in self.shadows:
             parts.append(shadow.serialize())
         shadow_size = sum(len(p) for p in parts)
-        assert len(self.compression_table) == self.size
+        if len(self.compression_table) != self.size:
+            from ...utils.validation import WireFormatError
+
+            raise WireFormatError(
+                "delta.table_desync",
+                "compression table out of sync with shadow list",
+                table_size=len(self.compression_table),
+                shadow_count=self.size,
+                address=self.address,
+            )
         for cell, idx in self.compression_table.items():
             ref = encode_cell(cell)
             parts.append(struct.pack(">hh", idx, len(ref)))

@@ -192,6 +192,11 @@ class CRGC(Engine):
         app_msg = AppMsg(msg, refs)
         target = ref.target
         fabric = self.system.fabric
+        tap = self.tap
+        if tap is not None:
+            tap.on_send(
+                target, remote=fabric is not None and target.system is not self.system
+            )
         if fabric is not None and target.system is not self.system:
             # Cross-node send: route through the link's egress/ingress
             # interceptors (reference: streams/Egress.scala:19-20).
@@ -205,6 +210,9 @@ class CRGC(Engine):
         """(reference: CRGC.scala:114-127)"""
         if isinstance(msg, AppMsg):
             if not msg.external:
+                tap = self.tap
+                if tap is not None:
+                    tap.on_recv(ctx.cell, crossed=msg.window_id >= 0)
                 if not state.can_record_message_received():
                     self.send_entry(state, is_busy=True)
                 state.record_message_received()
@@ -235,6 +243,9 @@ class CRGC(Engine):
     ) -> Refob:
         """(reference: CRGC.scala:151-162)"""
         ref = CrgcRefob(target.target, target.target_shadow)
+        tap = self.tap
+        if tap is not None:
+            tap.on_create(owner.target, target.target)
         if not state.can_record_new_refob():
             self.send_entry(state, is_busy=True)
         state.record_new_refob(owner, target)
@@ -244,7 +255,11 @@ class CRGC(Engine):
         self, releasing: Iterable[CrgcRefob], state: CrgcState, ctx: "ActorContext"
     ) -> None:
         """(reference: CRGC.scala:164-177)"""
+        tap = self.tap
         for ref in releasing:
+            if tap is not None:
+                # Before deactivation, so the tap can see a double release.
+                tap.on_release(ref, already_released=(ref.info & 1) == 1)
             if not state.can_record_updated_refob(ref):
                 self.send_entry(state, is_busy=True)
             ref.deactivate()

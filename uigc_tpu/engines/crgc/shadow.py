@@ -290,25 +290,54 @@ class ShadowGraph:
     # ------------------------------------------------------------- #
 
     def assert_equals(self, other: "ShadowGraph") -> None:
-        """Differential-testing helper comparing two graphs built from the
-        same entry stream (reference: ShadowGraph.java:176-199)."""
-        assert set(self.shadow_map.keys()) == set(other.shadow_map.keys()), (
-            "shadow maps differ: "
-            f"only-here={[c.path for c in set(self.shadow_map) - set(other.shadow_map)]} "
-            f"only-there={[c.path for c in set(other.shadow_map) - set(self.shadow_map)]}"
-        )
+        """Differential-testing check comparing two graphs built from the
+        same entry stream (reference: ShadowGraph.java:176-199
+        ``assertEquals``).  Raises :class:`GraphMismatchError` — a
+        structured error that survives ``python -O`` and carries every
+        mismatching entry in its payload — instead of a bare assert."""
+        from ...utils.validation import GraphMismatchError
+
+        only_here = set(self.shadow_map) - set(other.shadow_map)
+        only_there = set(other.shadow_map) - set(self.shadow_map)
+        if only_here or only_there:
+            raise GraphMismatchError(
+                "graph.population",
+                "shadow maps cover different actors",
+                only_here=sorted(_cell_path(c) for c in only_here),
+                only_there=sorted(_cell_path(c) for c in only_there),
+            )
+        mismatches: List[dict] = []
         for cell, mine in self.shadow_map.items():
             theirs = other.shadow_map[cell]
-            assert mine.recv_count == theirs.recv_count, (mine, theirs)
-            assert mine.is_root == theirs.is_root, (mine, theirs)
-            assert mine.interned == theirs.interned, (mine, theirs)
-            assert mine.is_busy == theirs.is_busy, (mine, theirs)
+            diffs = {}
+            for field in ("recv_count", "is_root", "interned", "is_busy"):
+                a, b = getattr(mine, field), getattr(theirs, field)
+                if a != b:
+                    diffs[field] = (a, b)
             mine_sup = mine.supervisor.self_cell if mine.supervisor else None
             their_sup = theirs.supervisor.self_cell if theirs.supervisor else None
-            assert mine_sup is their_sup, (mine, theirs)
+            if mine_sup is not their_sup:
+                diffs["supervisor"] = (
+                    _cell_path(mine_sup) if mine_sup else None,
+                    _cell_path(their_sup) if their_sup else None,
+                )
+            # Compare by cell identity (distinct cells can share a path
+            # across nodes); render paths only in the evidence payload.
             mine_out = {s.self_cell: c for s, c in mine.outgoing.items()}
             their_out = {s.self_cell: c for s, c in theirs.outgoing.items()}
-            assert mine_out == their_out, (mine, theirs)
+            if mine_out != their_out:
+                diffs["outgoing"] = (
+                    sorted((_cell_path(c), n) for c, n in mine_out.items()),
+                    sorted((_cell_path(c), n) for c, n in their_out.items()),
+                )
+            if diffs:
+                mismatches.append({"actor": _cell_path(cell), "fields": diffs})
+        if mismatches:
+            raise GraphMismatchError(
+                "graph.mismatch",
+                f"{len(mismatches)} shadow(s) disagree between the graphs",
+                mismatches=mismatches,
+            )
 
     def addresses_in_graph(self) -> Dict[str, int]:
         """Uncollected shadows per node address

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, List, Optional
 
 from ...interfaces import State as StateBase
+from ...utils.validation import CapacityError
 from . import refob as refob_info
 from .refob import SHORT_MAX, CrgcRefob
 
@@ -115,7 +116,15 @@ class CrgcState(StateBase):
         return self.created_idx < self.context.entry_field_size
 
     def record_new_refob(self, owner: CrgcRefob, target: CrgcRefob) -> None:
-        assert self.can_record_new_refob()
+        if not self.can_record_new_refob():
+            raise CapacityError(
+                "state.capacity",
+                "created-refs field written past capacity without a flush",
+                field="created",
+                index=self.created_idx,
+                capacity=self.context.entry_field_size,
+                actor=self.self_ref.target.path,
+            )
         i = self.created_idx
         self.created_idx = i + 1
         self.created_owners[i] = owner
@@ -125,7 +134,15 @@ class CrgcState(StateBase):
         return self.spawned_idx < self.context.entry_field_size
 
     def record_new_actor(self, child: CrgcRefob) -> None:
-        assert self.can_record_new_actor()
+        if not self.can_record_new_actor():
+            raise CapacityError(
+                "state.capacity",
+                "spawned-actors field written past capacity without a flush",
+                field="spawned",
+                index=self.spawned_idx,
+                capacity=self.context.entry_field_size,
+                actor=self.self_ref.target.path,
+            )
         self.spawned_actors[self.spawned_idx] = child
         self.spawned_idx += 1
 
@@ -133,7 +150,16 @@ class CrgcState(StateBase):
         return refob.has_been_recorded or self.updated_idx < self.context.entry_field_size
 
     def record_updated_refob(self, refob: CrgcRefob) -> None:
-        assert self.can_record_updated_refob(refob)
+        if not self.can_record_updated_refob(refob):
+            raise CapacityError(
+                "state.capacity",
+                "updated-refobs field written past capacity without a flush",
+                field="updated",
+                index=self.updated_idx,
+                capacity=self.context.entry_field_size,
+                actor=self.self_ref.target.path,
+                refob=repr(refob),
+            )
         if refob.has_been_recorded:
             return
         refob.set_has_been_recorded()
@@ -144,7 +170,15 @@ class CrgcState(StateBase):
         return self.recv_count < SHORT_MAX
 
     def record_message_received(self) -> None:
-        assert self.can_record_message_received()
+        if not self.can_record_message_received():
+            raise CapacityError(
+                "state.capacity",
+                "receive count saturated without a flush",
+                field="recv_count",
+                value=self.recv_count,
+                capacity=SHORT_MAX,
+                actor=self.self_ref.target.path,
+            )
         self.recv_count += 1
 
     # Flush (reference: State.java:90-124) ----------------------------- #

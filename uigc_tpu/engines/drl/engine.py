@@ -292,6 +292,8 @@ class DRL(Engine):
         self, ref: DrlRefob, msg: Any, refs: Iterable[Refob], state: DrlState, ctx: "ActorContext"
     ) -> None:
         """(reference: drl/DRL.scala:148-160)"""
+        if self.tap is not None:
+            self.tap.on_send(ref.target)
         ref.target.tell(DrlAppMsg(msg, ref.token, refs))
         state.inc_sent(ref.token)
 
@@ -300,6 +302,10 @@ class DRL(Engine):
     ) -> Optional[Any]:
         """(reference: drl/DRL.scala:62-88)"""
         if isinstance(msg, DrlAppMsg):
+            # token None marks the root adapter's external wrap: no
+            # sender-side accounting exists for it, so the tap skips it.
+            if self.tap is not None and msg.token is not None:
+                self.tap.on_recv(ctx.cell)
             state.handle_message(msg.refs, msg.token)
             return msg.payload
         if isinstance(msg, ReleaseMsg):
@@ -338,6 +344,8 @@ class DRL(Engine):
         self, target: DrlRefob, owner: DrlRefob, state: DrlState, ctx: "ActorContext"
     ) -> Refob:
         """(reference: drl/DRL.scala:108-118)"""
+        if self.tap is not None:
+            self.tap.on_create(owner.target, target.target)
         token = state.new_token()
         ref = DrlRefob(token, owner.target, target.target)
         state.handle_created_ref(target, ref)
@@ -347,6 +355,13 @@ class DRL(Engine):
         self, releasing: Iterable[DrlRefob], state: DrlState, ctx: "ActorContext"
     ) -> None:
         """(reference: drl/DRL.scala:120-132)"""
+        releasing = list(releasing)
+        tap = self.tap
+        if tap is not None:
+            for ref in releasing:
+                tap.on_release(
+                    ref, already_released=ref not in state.active_refs
+                )
         targets = state.release(releasing)
         for target_cell, (released, created) in targets.items():
             target_cell.tell(ReleaseMsg(released, created))

@@ -31,12 +31,44 @@ class TerminationDecision(enum.Enum):
     UNHANDLED = "unhandled"
 
 
+class EngineTap:
+    """Observation points for correctness tooling (uigc_tpu/analysis).
+
+    An engine with a non-None ``tap`` calls these from its hook
+    implementations; all calls are cheap no-ops by default.  The taps
+    observe, never mutate: ``on_release`` fires *before* the engine
+    deactivates the refob so the tap can see prior state, and
+    ``on_send`` fires before delivery so a tap-side send count always
+    happens-before the matching receive.  No reference analogue — the
+    reference debugs with in-source asserts instead."""
+
+    def on_send(self, target: "ActorCell", remote: bool = False) -> None:
+        """An application message is about to be delivered to ``target``."""
+
+    def on_recv(self, cell: "ActorCell", crossed: bool = False) -> None:
+        """``cell`` is receiving a (non-external) application message;
+        ``crossed`` marks messages that crossed a node boundary."""
+
+    def on_create(self, owner: "ActorCell", target: "ActorCell") -> None:
+        """A reference to ``target`` was created for ``owner``."""
+
+    def on_release(self, ref: Any, already_released: bool = False) -> None:
+        """``ref`` is about to be released; ``already_released`` means the
+        engine had already seen a release for it (a protocol violation)."""
+
+    def on_stop_decision(self, cell: "ActorCell", msg: Any) -> None:
+        """The engine decided ``cell`` SHOULD_STOP after processing
+        ``msg`` (called by the runtime before the stop is initiated)."""
+
+
 class Engine:
     """A GC engine: a collection of hooks and datatypes used by the
     runtime.  One instance per ActorSystem (reference: Engine.scala:19)."""
 
     def __init__(self, system: "ActorSystem"):
         self.system = system
+        #: optional :class:`EngineTap` installed by the sanitizer.
+        self.tap: Optional[EngineTap] = None
 
     # -- Root-actor support ------------------------------------------- #
 

@@ -53,12 +53,21 @@ class MacRefob(Refob):
 class MacAppMsg(GCMessage):
     """(reference: MAC.scala:30-31)"""
 
-    __slots__ = ("payload", "_refs", "is_self_msg")
+    __slots__ = ("payload", "_refs", "is_self_msg", "external")
 
-    def __init__(self, payload: Any, refs: Iterable[Refob], is_self_msg: bool):
+    def __init__(
+        self,
+        payload: Any,
+        refs: Iterable[Refob],
+        is_self_msg: bool,
+        external: bool = False,
+    ):
         self.payload = payload
         self._refs = tuple(refs)
         self.is_self_msg = is_self_msg
+        #: wrapped by the root adapter (sent by unmanaged code): carries
+        #: no sender-side accounting, so observation taps skip it.
+        self.external = external
 
     @property
     def refs(self) -> Tuple[Refob, ...]:
@@ -183,7 +192,7 @@ class MAC(Engine):
     # -- Root support -------------------------------------------------- #
 
     def root_message(self, payload: Any, refs: Iterable[Refob]) -> GCMessage:
-        return MacAppMsg(payload, refs, is_self_msg=False)
+        return MacAppMsg(payload, refs, is_self_msg=False, external=True)
 
     def root_spawn_info(self) -> SpawnInfo:
         return MacSpawnInfo(is_root=True)
@@ -249,6 +258,8 @@ class MAC(Engine):
         is_self_msg = ref.target is state.self_ref.target
         if is_self_msg:
             state.pending_self_messages += 1
+        if self.tap is not None:
+            self.tap.on_send(ref.target)
         ref.target.tell(MacAppMsg(msg, refs, is_self_msg))
 
     def on_message(
@@ -257,6 +268,8 @@ class MAC(Engine):
         """(reference: MAC.scala:175-210)"""
         cell = ctx.cell
         if isinstance(msg, MacAppMsg):
+            if self.tap is not None and not msg.external:
+                self.tap.on_recv(cell)
             self._unblocked(state, cell)
             state.app_msg_count += 1
             if msg.is_self_msg:
@@ -327,6 +340,8 @@ class MAC(Engine):
         self, target: MacRefob, owner: Refob, state: MacState, ctx: "ActorContext"
     ) -> Refob:
         """Weight splitting (reference: MAC.scala:248-266)."""
+        if self.tap is not None:
+            self.tap.on_create(owner.target, target.target)
         if target.target is ctx.cell:
             state.rc += 1
             return MacRefob(target.target)
@@ -342,7 +357,16 @@ class MAC(Engine):
         self, releasing: Iterable[MacRefob], state: MacState, ctx: "ActorContext"
     ) -> None:
         """(reference: MAC.scala:268-288)"""
+        tap = self.tap
         for ref in releasing:
+            if tap is not None:
+                tap.on_release(
+                    ref,
+                    already_released=(
+                        ref.target is not ctx.cell
+                        and ref.target not in state.actor_map
+                    ),
+                )
             if ref.target is ctx.cell:
                 state.rc -= 1
             else:

@@ -27,6 +27,7 @@ from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 
 from ..engines.engine import TerminationDecision
 from ..interfaces import GCMessage, Message
+from ..utils import events
 from .behaviors import SameBehavior, StoppedBehavior
 from .signals import PostStop, Terminated
 
@@ -141,6 +142,14 @@ class ActorCell:
         if dead:
             self.system.record_dead_letter(self, msg)
             return
+        if self.system.sched_events and events.recorder.enabled:
+            events.recorder.commit(
+                events.SCHED_ENQUEUE,
+                cell=self.uid,
+                path=self.path,
+                kind="app",
+                thread=threading.get_ident(),
+            )
         if dispatch:
             self._dispatcher.execute(self._process_batch)
 
@@ -150,6 +159,14 @@ class ActorCell:
                 return
             self._sysbox.append(msg)
             dispatch = self._mark_scheduled()
+        if self.system.sched_events and events.recorder.enabled:
+            events.recorder.commit(
+                events.SCHED_ENQUEUE,
+                cell=self.uid,
+                path=self.path,
+                kind="sys",
+                thread=threading.get_ident(),
+            )
         if dispatch:
             self._dispatcher.execute(self._process_batch)
 
@@ -178,6 +195,19 @@ class ActorCell:
     def _process_batch(self) -> None:
         throughput = self.system.throughput
         processed = 0
+        # Scheduling taps for the race detector (analysis/race.py): the
+        # batch_start/batch_end pair brackets this thread's exclusive
+        # ownership of the cell; batch_end is committed BEFORE the
+        # ``_scheduled`` flag is released so the next batch's start event
+        # can never be sequenced inside this batch's interval.
+        sched = self.system.sched_events and events.recorder.enabled
+        if sched:
+            events.recorder.commit(
+                events.SCHED_BATCH_START,
+                cell=self.uid,
+                path=self.path,
+                thread=threading.get_ident(),
+            )
         while True:
             # System messages always drain first.
             while True:
@@ -185,6 +215,14 @@ class ActorCell:
                     sysmsg = self._sysbox.popleft() if self._sysbox else None
                 if sysmsg is None:
                     break
+                if sched:
+                    events.recorder.commit(
+                        events.SCHED_INVOKE,
+                        cell=self.uid,
+                        path=self.path,
+                        kind="sys",
+                        thread=threading.get_ident(),
+                    )
                 self._invoke_system(sysmsg)
             if self._lifecycle != _ACTIVE or processed >= throughput:
                 break
@@ -194,6 +232,14 @@ class ActorCell:
                 break
             processed += 1
             self._needs_block_hook = True
+            if sched:
+                events.recorder.commit(
+                    events.SCHED_INVOKE,
+                    cell=self.uid,
+                    path=self.path,
+                    kind="app",
+                    thread=threading.get_ident(),
+                )
             try:
                 self._invoke(msg)
             except Exception:
@@ -221,6 +267,13 @@ class ActorCell:
                 except Exception:  # pragma: no cover - defensive
                     traceback.print_exc()
 
+        if sched:
+            events.recorder.commit(
+                events.SCHED_BATCH_END,
+                cell=self.uid,
+                path=self.path,
+                thread=threading.get_ident(),
+            )
         with self._lock:
             if self._lifecycle != _TERMINATED and (self._mailbox or self._sysbox):
                 redispatch = True
@@ -271,6 +324,14 @@ class ActorCell:
         if decision is TerminationDecision.SHOULD_STOP or isinstance(
             result, StoppedBehavior
         ):
+            if decision is TerminationDecision.SHOULD_STOP and engine.tap is not None:
+                try:
+                    engine.tap.on_stop_decision(self, msg)
+                except Exception:
+                    # A tap must never alter control flow: the stop
+                    # proceeds, and on the signal path an escaped raise
+                    # would wedge the cell with _scheduled claimed.
+                    traceback.print_exc()
             self._initiate_stop()
         else:
             self._apply_behavior_result(result)
@@ -301,6 +362,11 @@ class ActorCell:
         if decision is TerminationDecision.SHOULD_STOP or isinstance(
             result, StoppedBehavior
         ):
+            if decision is TerminationDecision.SHOULD_STOP and engine.tap is not None:
+                try:
+                    engine.tap.on_stop_decision(self, signal)
+                except Exception:
+                    traceback.print_exc()
             self._initiate_stop()
         else:
             self._apply_behavior_result(result)
@@ -344,6 +410,14 @@ class ActorCell:
         """All children are gone: run PostStop, notify watchers and parent."""
         if self._lifecycle == _TERMINATED:
             return
+        sched = self.system.sched_events and events.recorder.enabled
+        if sched:
+            events.recorder.commit(
+                events.SCHED_POSTSTOP,
+                cell=self.uid,
+                path=self.path,
+                thread=threading.get_ident(),
+            )
         self._invoke_signal(PostStop)
         with self._lock:
             self._lifecycle = _TERMINATED
@@ -351,6 +425,16 @@ class ActorCell:
             self._mailbox.clear()
             watchers = list(self._watchers)
             self._watchers.clear()
+        if sched:
+            # Committed before the parent is notified, so a parent's
+            # poststop event is always sequenced after every child's
+            # terminated event in a correct run.
+            events.recorder.commit(
+                events.SCHED_TERMINATED,
+                cell=self.uid,
+                path=self.path,
+                thread=threading.get_ident(),
+            )
         if dropped:
             self.system.record_dead_letters_dropped(self, dropped)
         for watcher in watchers:

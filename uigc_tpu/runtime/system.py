@@ -70,6 +70,10 @@ class ActorSystem:
         self._uid_counter = itertools.count(1)
         self._uid_lock = threading.Lock()
         self.throughput = self.config.get_int("uigc.runtime.throughput")
+        #: emit ``sched.*`` scheduling events from the cell layer (for
+        #: the race detector, analysis/race.py); read by every cell on
+        #: its hot path, so it is a plain attribute, not a config lookup.
+        self.sched_events = self.config.get_bool("uigc.analysis.sched-events")
         self.dispatcher = Dispatcher(
             self.config.get_int("uigc.runtime.num-workers"), name=f"{name}-dispatcher"
         )
@@ -93,6 +97,15 @@ class ActorSystem:
         from ..engines import create_engine
 
         self.engine = create_engine(self)
+
+        #: Online sanitizer (uigcsan), attached on request — it wraps
+        #: the engine's hooks and collector graph with an independent
+        #: oracle and cross-checks every collection cycle.
+        self.sanitizer: Optional[Any] = None
+        if self.config.get_bool("uigc.analysis.sanitizer"):
+            from ..analysis import Sanitizer
+
+            self.sanitizer = Sanitizer.attach(self)
 
         if fabric is not None:
             fabric.register_system(self)
@@ -126,6 +139,14 @@ class ActorSystem:
         if name in parent.children:
             raise ValueError(f"duplicate actor name {name!r} under {parent.path}")
         parent.children[name] = cell
+        if self.sched_events and events.recorder.enabled:
+            events.recorder.commit(
+                events.SCHED_SPAWN,
+                cell=cell.uid,
+                path=cell.path,
+                parent=parent.uid,
+                thread=threading.get_ident(),
+            )
         ctx = ActorContext(cell, spawn_info)
         cell.context = ctx
         cell.behavior = factory.setup_fn(ctx)

@@ -52,6 +52,34 @@ DEVICE_TRACE = "tpu.device_trace"  # ours: one device kernel dispatch
 #   fabric.frame_gap        receiver seq layer observed missing frames
 #   fabric.frame_corrupt    frame body failed to decode (truncation)
 #   crgc.undo_fold          a dead node's undo log folded into the graph
+# Correctness-tooling events (ours; uigc_tpu/analysis):
+#   analysis.violation      the sanitizer recorded a violated invariant;
+#                           fields: rule, detail, plus rule-specific
+#                           evidence (see analysis/sanitizer.py catalog)
+#   analysis.check          one sanitizer cross-check cycle completed;
+#                           fields: node, n_garbage, oracle_garbage
+#   sched.*                 scheduling taps consumed by the vector-clock
+#                           race detector (analysis/race.py); emitted by
+#                           runtime/cell.py and runtime/system.py only
+#                           when ``uigc.analysis.sched-events`` is on:
+#   sched.enqueue           a message was appended to a mailbox
+#                           (fields: cell, kind="sys"|"app")
+#   sched.batch_start       a dispatcher thread began a cell batch
+#   sched.batch_end         the batch released ownership of the cell
+#   sched.invoke            one message is about to be invoked
+#   sched.spawn             a cell was registered under a parent
+#   sched.poststop          PostStop is about to run for a cell
+#   sched.terminated        the cell reached its terminal state
+ANALYSIS_VIOLATION = "analysis.violation"
+ANALYSIS_CHECK = "analysis.check"
+SCHED_ENQUEUE = "sched.enqueue"
+SCHED_BATCH_START = "sched.batch_start"
+SCHED_BATCH_END = "sched.batch_end"
+SCHED_INVOKE = "sched.invoke"
+SCHED_SPAWN = "sched.spawn"
+SCHED_POSTSTOP = "sched.poststop"
+SCHED_TERMINATED = "sched.terminated"
+
 NODE_SUSPECT = "fabric.node_suspect"
 NODE_DOWN = "fabric.node_down"
 NODE_CRASHED = "fabric.node_crashed"
@@ -66,11 +94,20 @@ UNDO_FOLD = "crgc.undo_fold"
 
 
 class EventRecorder:
-    """Thread-safe counter/duration sink with optional listeners."""
+    """Thread-safe counter/duration sink with optional listeners.
+
+    Listener dispatch is exception-isolated: one throwing listener must
+    not break ``commit`` for the others (or for the caller), and
+    ``add_listener``/``remove_listener`` are safe against concurrent
+    commits.  Every committed event carries a ``seq`` field stamped
+    under the recorder lock — a process-wide total order consistent
+    with real time, which the race detector (analysis/race.py) relies
+    on to order events across dispatcher threads."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.enabled = False
+        self._seq = 0
         self._counts: Dict[str, int] = defaultdict(int)
         self._sums: Dict[str, float] = defaultdict(float)
         self._durations: Dict[str, List[float]] = defaultdict(list)
@@ -102,9 +139,19 @@ class EventRecorder:
                     self._sums[f"{name}.{key}"] += value
             if duration_s is not None:
                 self._durations[name].append(duration_s)
+            seq = self._seq
+            self._seq = seq + 1
             listeners = list(self._listeners)
+        if not listeners:
+            return
+        payload = dict(fields, duration_s=duration_s, seq=seq)
         for fn in listeners:
-            fn(name, dict(fields, duration_s=duration_s))
+            try:
+                fn(name, dict(payload))
+            except Exception:  # one bad listener must not break the rest
+                import traceback
+
+                traceback.print_exc()
 
     def timed(self, name: str) -> "_Timed":
         return _Timed(self, name)

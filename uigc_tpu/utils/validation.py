@@ -1,0 +1,72 @@
+"""Structured runtime-invariant validation.
+
+The reference debugs its collector with bare JVM ``assert``s
+(reference: ShadowGraph.java:176-199); Python's equivalent is stripped
+under ``python -O``, which silently disables the very checks that guard
+GC soundness.  This module is the repo-wide replacement: invariant
+checks raise :class:`InvariantViolation` subclasses that always run,
+carry the mismatching entries as a structured payload (machine-readable
+by tests and by the sanitizer in ``uigc_tpu/analysis``), and render a
+readable message.
+
+Rule names are short dotted strings (``"graph.mismatch"``,
+``"state.capacity"``) shared with the sanitizer's violation catalog so
+one vocabulary covers both inline validation and online checking.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class InvariantViolation(Exception):
+    """A runtime invariant did not hold.
+
+    Attributes:
+        rule: short dotted identifier of the violated invariant.
+        detail: one-line human explanation.
+        payload: structured evidence (the mismatching entries), safe to
+            serialize with ``repr``.
+    """
+
+    def __init__(self, rule: str, detail: str, **payload: Any):
+        self.rule = rule
+        self.detail = detail
+        self.payload: Dict[str, Any] = payload
+        super().__init__(self._render())
+
+    def _render(self) -> str:
+        if not self.payload:
+            return f"[{self.rule}] {self.detail}"
+        fields = ", ".join(f"{k}={v!r}" for k, v in self.payload.items())
+        return f"[{self.rule}] {self.detail} ({fields})"
+
+
+class GraphMismatchError(InvariantViolation):
+    """Two graphs built from the same entry stream disagree
+    (the dual-graph differential check, reference:
+    ShadowGraph.java:176-199 ``assertEquals``)."""
+
+
+class CapacityError(InvariantViolation):
+    """A bounded record was written past its capacity check — the
+    caller skipped the ``can_record_*`` guard the protocol requires
+    (reference: State.java:49-88)."""
+
+
+class WireFormatError(InvariantViolation):
+    """A serialization-side consistency check failed (e.g. compression
+    table out of sync with the shadow list)."""
+
+
+def require(
+    condition: bool,
+    rule: str,
+    detail: str,
+    cls: Optional[type] = None,
+    **payload: Any,
+) -> None:
+    """Raise ``cls`` (default :class:`InvariantViolation`) unless
+    ``condition`` holds.  Unlike ``assert`` this survives ``python -O``."""
+    if not condition:
+        raise (cls or InvariantViolation)(rule, detail, **payload)
