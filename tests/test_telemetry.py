@@ -1,0 +1,752 @@
+"""Telemetry subsystem suite (uigc_tpu/telemetry).
+
+Layers, bottom up:
+
+- registry math: counter/gauge/histogram semantics, fixed bucket
+  bounds, label handling;
+- event recorder satellites: O(buckets) duration memory under a
+  1M-timed-event loop, structured listener-error accounting;
+- exporters: Prometheus text exposition parses and is internally
+  consistent, the localhost HTTP handle serves it, JSONL persistence
+  replays into the same metrics and into ``RaceDetector.feed()`` with
+  verdicts identical to the live listener;
+- causal tracing: trace ids propagate across a real 2-node
+  ``NodeFabric`` link (and a peer with tracing OFF ignores the frame
+  header without dropping traffic);
+- the acceptance scenario: a 3-node chaos run with telemetry on
+  exports a Chrome-trace JSON whose causally-linked spans span >= 2
+  nodes (send on A -> invoke on B -> GC wave -> terminate) and a wake
+  profile attributing >= 4 named phases per wake, with nonzero
+  wave/fault metrics.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from uigc_tpu import (
+    AbstractBehavior,
+    ActorTestKit,
+    Behaviors,
+    Message,
+    NoRefs,
+    PostStop,
+)
+from uigc_tpu.analysis import RaceDetector
+from uigc_tpu.runtime.faults import FaultPlan
+from uigc_tpu.runtime.node import NodeFabric
+from uigc_tpu.runtime.system import ActorSystem
+from uigc_tpu.runtime.testkit import TestProbe
+from uigc_tpu.runtime.behaviors import RawBehavior
+from uigc_tpu.telemetry import (
+    EventMetricsBridge,
+    MetricsRegistry,
+    chrome_trace,
+    prometheus_text,
+    replay_jsonl,
+)
+from uigc_tpu.telemetry.metrics import COUNT_BUCKETS
+from uigc_tpu.utils import events
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    """Telemetry enables the process-global recorder; leave no residue
+    for the rest of the suite."""
+    yield
+    events.recorder.disable()
+    events.recorder.reset()
+    with events.recorder._lock:
+        events.recorder._listeners.clear()
+
+
+# ------------------------------------------------------------------- #
+# Metric registry math
+# ------------------------------------------------------------------- #
+
+
+def test_counter_math_and_labels():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "help")
+    counter.inc()
+    counter.inc(2.5)
+    counter.inc(src="a")
+    counter.inc(3, src="a")
+    assert counter.value() == 3.5
+    assert counter.value(src="a") == 4.0
+    with pytest.raises(Exception):
+        counter.inc(-1)
+    # idempotent re-registration returns the same object
+    assert registry.counter("c_total") is counter
+
+
+def test_gauge_set_and_callback_fanout():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("g")
+    gauge.set(7)
+    gauge.set(9)
+    assert gauge.value() == 9.0
+    phi = registry.gauge("phi", fn=lambda: {"b": 1.5, "c": 0.25}, label_name="peer")
+    samples = {labels: value for _, labels, value in phi.samples()}
+    assert samples[(("peer", "b"),)] == 1.5
+    assert samples[(("peer", "c"),)] == 0.25
+    broken = registry.gauge("broken", fn=lambda: 1 / 0)
+    assert broken.samples() == []  # dead callback never breaks a scrape
+
+
+def test_histogram_bucket_bounds():
+    registry = MetricsRegistry()
+    hist = registry.histogram("h", buckets=(1.0, 2.0, 4.0))
+    for value in (0.5, 1.0, 1.5, 3.0, 100.0):
+        hist.observe(value)
+    snap = hist.snapshot()
+    # non-cumulative internals: (<=1.0): 0.5 and 1.0; (<=2.0): 1.5;
+    # (<=4.0): 3.0; overflow: 100.0
+    assert snap["counts"] == [2, 1, 1, 1]
+    assert snap["n"] == 5
+    assert snap["sum"] == pytest.approx(106.0)
+    assert snap["min"] == 0.5 and snap["max"] == 100.0
+    # exported cumulative series
+    by_le = {
+        dict(labels)["le"]: value
+        for suffix, labels, value in hist.samples()
+        if suffix == "_bucket"
+    }
+    assert by_le["1.0"] == 2 and by_le["2.0"] == 3 and by_le["4.0"] == 4
+    assert by_le["+Inf"] == 5
+
+
+# ------------------------------------------------------------------- #
+# Event recorder satellites
+# ------------------------------------------------------------------- #
+
+
+def test_event_recorder_duration_memory_is_bounded():
+    """1M timed events must hold O(buckets), not O(events)."""
+    recorder = events.EventRecorder()
+    recorder.enable()
+    n = 1_000_000
+    for i in range(n):
+        recorder.commit("bench.timed", duration_s=1e-6 * (i % 1000 + 1))
+    stat = recorder._durations["bench.timed"]
+    # The storage is the fixed bucket array plus four scalars — nothing
+    # proportional to the observation count.
+    assert isinstance(stat, events.DurationStat)
+    assert len(stat.buckets) == len(events.DURATION_BUCKET_BOUNDS_S) + 1
+    assert not hasattr(stat, "__dict__")  # slots only: no growable side table
+    snap = recorder.snapshot()["durations"]["bench.timed"]
+    # backward-compatible shape plus the streaming extras
+    assert snap["n"] == n
+    assert snap["total_s"] == pytest.approx(sum(1e-6 * (i % 1000 + 1) for i in range(1000)) * (n // 1000), rel=1e-6)
+    assert snap["max_s"] == pytest.approx(1e-3)
+    assert snap["min_s"] == pytest.approx(1e-6)
+    assert sum(snap["buckets"]) == n
+
+
+def test_listener_error_is_structured_and_counted(capsys):
+    recorder = events.EventRecorder()
+    recorder.enable()
+    seen = []
+
+    def broken(name, fields):
+        if name != events.LISTENER_ERROR:
+            raise RuntimeError("boom")
+
+    recorder.add_listener(broken)
+    recorder.add_listener(lambda name, fields: seen.append((name, fields)))
+    recorder.commit("some.event", value=1)
+    snap = recorder.snapshot()
+    assert snap["counts"][events.LISTENER_ERROR] == 1
+    # the surviving listener saw both the original and the error event
+    names = [name for name, _ in seen]
+    assert "some.event" in names and events.LISTENER_ERROR in names
+    error_fields = dict(seen)[events.LISTENER_ERROR]
+    assert error_fields["event"] == "some.event"
+    assert "RuntimeError" in error_fields["error"]
+    assert "boom" in capsys.readouterr().err  # stderr traceback retained
+
+
+def test_listener_error_recursion_is_bounded():
+    recorder = events.EventRecorder()
+    recorder.enable()
+
+    def always_broken(name, fields):
+        raise RuntimeError("always")
+
+    recorder.add_listener(always_broken)
+    recorder.commit("e1")  # must not recurse to death
+    snap = recorder.snapshot()
+    assert snap["counts"]["e1"] == 1
+    assert snap["counts"][events.LISTENER_ERROR] >= 1
+
+
+# ------------------------------------------------------------------- #
+# Prometheus exposition + HTTP handle
+# ------------------------------------------------------------------- #
+
+_SAMPLE_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE.+-]+(inf)?$"
+)
+
+
+def test_prometheus_exposition_parses():
+    registry = MetricsRegistry(const_labels={"node": "uigc://n1"})
+    registry.counter("a_total", "a help").inc(3)
+    registry.gauge("b").set(1.25, peer='uigc://x"y\n')
+    hist = registry.histogram("c_seconds", buckets=(0.1, 1.0))
+    hist.observe(0.05)
+    hist.observe(5.0)
+    text = prometheus_text(registry)
+    sample_lines = [l for l in text.splitlines() if l and not l.startswith("#")]
+    assert sample_lines, text
+    for line in sample_lines:
+        assert _SAMPLE_LINE.match(line), f"unparseable sample line: {line!r}"
+    # histogram consistency: +Inf bucket == _count
+    inf = next(l for l in sample_lines if 'le="+Inf"' in l)
+    count = next(l for l in sample_lines if l.startswith("c_seconds_count"))
+    assert inf.rsplit(" ", 1)[1] == count.rsplit(" ", 1)[1] == "2"
+    # every sample carries the constant node label
+    assert all('node="uigc://n1"' in l for l in sample_lines)
+
+
+def test_http_handle_serves_metrics():
+    kit = ActorTestKit(
+        config={
+            "uigc.telemetry.metrics": True,
+            "uigc.telemetry.http-port": 0,
+            "uigc.crgc.wakeup-interval": 10,
+        },
+        name="telhttp",
+    )
+    try:
+        telemetry = kit.system.telemetry
+        assert telemetry is not None and telemetry.http is not None
+        time.sleep(0.1)
+        base = f"http://127.0.0.1:{telemetry.http.port}"
+        text = urllib.request.urlopen(base + "/metrics", timeout=5).read().decode()
+        assert "uigc_live_actors" in text
+        snap = json.loads(
+            urllib.request.urlopen(base + "/metrics.json", timeout=5).read()
+        )
+        assert snap["uigc_live_actors"]["kind"] == "gauge"
+    finally:
+        kit.shutdown()
+
+
+# ------------------------------------------------------------------- #
+# JSONL persistence + replay parity
+# ------------------------------------------------------------------- #
+
+
+class _Ping(NoRefs):
+    pass
+
+
+class _Release(NoRefs):
+    pass
+
+
+class _Worker(AbstractBehavior):
+    def on_message(self, msg):
+        return self
+
+
+class _Root(AbstractBehavior):
+    def __init__(self, context):
+        super().__init__(context)
+        self.workers = [
+            context.spawn(Behaviors.setup(_Worker), f"w{i}") for i in range(4)
+        ]
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, _Ping):
+            for worker in self.workers:
+                worker.tell(_Ping(), ctx)
+        elif self.workers:
+            ctx.release(*self.workers)
+            self.workers = []
+        return self
+
+
+def test_jsonl_replay_matches_live_race_detector(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    live = RaceDetector().attach()
+    kit = ActorTestKit(
+        config={
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.analysis.sched-events": True,
+            "uigc.telemetry.jsonl-path": path,
+        },
+        name="teljsonl",
+    )
+    try:
+        root = kit.spawn(Behaviors.setup_root(_Root), "root")
+        for _ in range(10):
+            root.tell(_Ping())
+        time.sleep(0.3)
+        root.tell(_Release())
+        time.sleep(0.4)
+    finally:
+        kit.shutdown()
+        live.detach()
+    assert live.event_count() > 0
+    replayed = RaceDetector().feed(replay_jsonl(path))
+    assert replayed.event_count() == live.event_count()
+    live_verdicts = [(v.rule, v.payload.get("cell")) for v in live.analyze()]
+    replay_verdicts = [(v.rule, v.payload.get("cell")) for v in replayed.analyze()]
+    assert replay_verdicts == live_verdicts
+    # a correct runtime shows no violations in either view
+    assert live_verdicts == []
+
+
+def test_jsonl_replay_rebuilds_metrics(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    kit = ActorTestKit(
+        config={
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.telemetry.metrics": True,
+            "uigc.telemetry.jsonl-path": path,
+        },
+        name="telreplay",
+    )
+    try:
+        root = kit.spawn(Behaviors.setup_root(_Root), "root")
+        for _ in range(10):
+            root.tell(_Ping())
+        time.sleep(0.4)
+        registry_live = kit.system.telemetry.registry
+    finally:
+        # Snapshot AFTER shutdown: listener detach and file close happen
+        # with all machinery quiesced, so live and replayed views cover
+        # exactly the same event stream.
+        kit.shutdown()
+    live_count = registry_live.snapshot()["uigc_gc_wave_seconds"]
+    registry = MetricsRegistry()
+    bridge = EventMetricsBridge(registry)
+    for name, fields in replay_jsonl(path):
+        bridge(name, fields)
+    replayed = registry.snapshot()["uigc_gc_wave_seconds"]
+
+    def count_of(entry):
+        return [
+            s["value"] for s in entry["samples"] if s["suffix"] == "_count"
+        ]
+
+    assert count_of(replayed) == count_of(live_count)
+    assert count_of(replayed)[0] > 0
+
+
+def test_sanitizer_oracle_trace_does_not_double_count_metrics():
+    """With uigcsan AND metrics on, the oracle's shadow re-trace must
+    not emit a second crgc.tracing/crgc.sweep per wake (suppressed
+    commits, utils/events.py) — garbage would count twice otherwise."""
+    kit = ActorTestKit(
+        config={
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.crgc.shadow-graph": "array",
+            "uigc.analysis.sanitizer": True,
+            "uigc.telemetry.metrics": True,
+        },
+        name="sanmetrics",
+    )
+    try:
+        root = kit.spawn(Behaviors.setup_root(_Root), "root")
+        for _ in range(5):
+            root.tell(_Ping())
+        time.sleep(0.2)
+        root.tell(_Release())
+        # Each collected actor contributes exactly TWO shadow frees to
+        # the crgc.tracing counts (the kill-wave free, then the free of
+        # the shadow its death flush re-interns), so 4 workers -> 8.
+        # An unsuppressed oracle re-trace would double that to 16.
+        deadline = time.monotonic() + 10.0
+        total = 0
+        while time.monotonic() < deadline and total < 8:
+            text = prometheus_text(kit.system.telemetry.registry)
+            got = re.search(r"uigc_gc_garbage_total(\{[^}]*\})? (\d+)", text)
+            total = int(got.group(2)) if got else 0
+            time.sleep(0.05)
+        assert total == 8, f"expected 8 shadow frees for 4 actors, got {total}"
+        assert kit.system.sanitizer.violations == []
+    finally:
+        kit.shutdown()
+
+
+def test_http_fixed_port_conflict_degrades_to_ephemeral():
+    """Two systems sharing a config with a fixed http-port must both
+    come up; the second falls back to an ephemeral port."""
+    kit_a = ActorTestKit(
+        config={"uigc.telemetry.http-port": 0}, name="porta"
+    )
+    fixed = kit_a.system.telemetry.http.port
+    kit_b = ActorTestKit(
+        config={"uigc.telemetry.http-port": fixed}, name="portb"
+    )
+    try:
+        port_b = kit_b.system.telemetry.http.port
+        assert port_b != fixed
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port_b}/metrics", timeout=5
+        ).read().decode()
+        assert "uigc_live_actors" in body
+    finally:
+        kit_a.shutdown()
+        kit_b.shutdown()
+
+
+def test_metrics_are_scoped_per_system_in_one_process():
+    """The recorder is a process singleton; two instrumented systems in
+    one process must NOT fold each other's GC events into their
+    registries (thread-origin scoping, utils/events.py)."""
+    config = {
+        "uigc.crgc.wakeup-interval": 10,
+        "uigc.telemetry.metrics": True,
+    }
+    kit_a = ActorTestKit(config=config, name="scopea")
+    kit_b = ActorTestKit(config=config, name="scopeb")
+    try:
+        root = kit_a.spawn(Behaviors.setup_root(_Root), "root")
+        for _ in range(10):
+            root.tell(_Ping())
+        time.sleep(0.3)
+        root.tell(_Release())  # garbage on A only
+        deadline = time.monotonic() + 10.0
+        bridge_a = None
+        while time.monotonic() < deadline:
+            text_a = prometheus_text(kit_a.system.telemetry.registry)
+            got = re.search(r"uigc_gc_garbage_total(\{[^}]*\})? (\d+)", text_a)
+            if got and int(got.group(2)) > 0:
+                break
+            time.sleep(0.05)
+        assert got and int(got.group(2)) > 0, "A never collected its garbage"
+        text_b = prometheus_text(kit_b.system.telemetry.registry)
+        got_b = re.search(r"uigc_gc_garbage_total(\{[^}]*\})? (\d+)", text_b)
+        assert got_b is None or int(got_b.group(2)) == 0, (
+            "B's registry absorbed A's garbage events"
+        )
+        # B still counts its OWN wakes — scoping filters, not silences.
+        waves_b = re.search(r"uigc_gc_wave_seconds_count\{[^}]*\} (\d+)", text_b)
+        assert waves_b and int(waves_b.group(1)) > 0
+    finally:
+        kit_a.shutdown()
+        kit_b.shutdown()
+
+
+# ------------------------------------------------------------------- #
+# Cross-node causal tracing
+# ------------------------------------------------------------------- #
+
+
+class _Probe:
+    def __init__(self, probe):
+        self.ref = probe
+
+
+class _ProbeForwarder(RawBehavior):
+    def __init__(self, probe):
+        self.probe = probe
+
+    def on_message(self, msg):
+        self.probe._offer(msg)
+        return None
+
+
+class _Spawned(NoRefs):
+    def __init__(self, name):
+        self.name = name
+
+
+class _Stopped(NoRefs):
+    def __init__(self, name):
+        self.name = name
+
+
+class _ShareMsg(Message):
+    def __init__(self, shared):
+        self.shared = shared
+
+    @property
+    def refs(self):
+        return (self.shared,) if self.shared is not None else ()
+
+
+class _RemoteWorker(AbstractBehavior):
+    def __init__(self, context, probe):
+        super().__init__(context)
+        self.probe = probe
+        probe.ref.tell(_Spawned(context.name))
+
+    def on_message(self, msg):
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe.ref.tell(_Stopped(self.context.name))
+        return None
+
+
+class _Driver(AbstractBehavior):
+    """Root on node A pinging a worker that lives on node B."""
+
+    def __init__(self, context, remote):
+        super().__init__(context)
+        self.remote = remote
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, _ShareMsg) and msg.shared is not None:
+            self.remote = msg.shared
+        elif isinstance(msg, _Ping) and self.remote is not None:
+            self.remote.tell(_Ping(), ctx)
+        elif isinstance(msg, _Release) and self.remote is not None:
+            ctx.release(self.remote)
+            self.remote = None
+        return self
+
+
+class _Owner(AbstractBehavior):
+    """Root on node B owning a managed worker child; shares the ref to
+    node A's driver, then releases its own copy on demand — after both
+    releases only a GC wave can terminate the worker."""
+
+    def __init__(self, context, probe, driver_ref):
+        super().__init__(context)
+        self.worker = context.spawn(
+            Behaviors.setup(lambda c: _RemoteWorker(c, probe)), "worker"
+        )
+        self.driver_ref = driver_ref
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, _ShareMsg):
+            self.driver_ref.tell(
+                _ShareMsg(ctx.create_ref(self.worker, self.driver_ref)), ctx
+            )
+        elif isinstance(msg, _Release) and self.worker is not None:
+            ctx.release(self.worker)
+            self.worker = None
+        return self
+
+
+def _spawn_node(name, num_nodes, overrides=None):
+    config = {
+        "uigc.crgc.wakeup-interval": 10,
+        "uigc.crgc.egress-finalize-interval": 5,
+        "uigc.crgc.num-nodes": num_nodes,
+        "uigc.telemetry.tracing": True,
+    }
+    if overrides:
+        config.update(overrides)
+    fabric = NodeFabric()
+    system = ActorSystem(None, name=name, config=config, fabric=fabric)
+    port = fabric.listen()
+    return fabric, system, port
+
+
+def _terminate_all(*systems):
+    for system in systems:
+        try:
+            system.terminate(timeout_s=5.0)
+        except Exception:
+            pass
+
+
+def test_trace_id_propagates_across_node_fabric():
+    fa, sa, _pa = _spawn_node("trca", 2)
+    fb, sb, pb = _spawn_node("trcb", 2)
+    try:
+        fa.connect("127.0.0.1", pb)
+        probe = TestProbe(default_timeout_s=20.0)
+        probe_cell = sb.system_probe = sb.spawn_system_raw(
+            _ProbeForwarder(probe), "probe-fwd"
+        )
+        worker = sb.spawn_root(
+            Behaviors.setup_root(lambda ctx: _RemoteWorker(ctx, _Probe(probe_cell))),
+            "worker",
+        )
+        proxy = fa._proxy(sb.address, worker.cell.uid)
+        driver = sa.spawn_root(
+            Behaviors.setup_root(
+                lambda ctx: _Driver(ctx, ctx.engine.to_root_refob(proxy))
+            ),
+            "driver",
+        )
+        probe.expect_message_type(_Spawned)
+        for _ in range(10):
+            driver.tell(_Ping())
+        deadline = time.monotonic() + 10.0
+        linked = []
+        while time.monotonic() < deadline and not linked:
+            sends = [s for s in sa.telemetry.tracer.spans() if s["name"] == "send"]
+            invokes = [
+                s for s in sb.telemetry.tracer.spans() if s["name"] == "invoke"
+            ]
+            send_ids = {s["span_id"] for s in sends}
+            send_traces = {s["trace_id"] for s in sends}
+            linked = [
+                s
+                for s in invokes
+                if s["trace_id"] in send_traces and s["parent_id"] in send_ids
+            ]
+            time.sleep(0.05)
+        assert linked, "no invoke span on B causally linked to a send on A"
+        assert linked[0]["node"] == sb.address
+    finally:
+        _terminate_all(sa, sb)
+
+
+def test_trace_header_ignored_by_peer_with_tracing_off():
+    """Version tolerance: A traces, B does not — B must deliver the
+    traffic (header silently ignored) and record nothing."""
+    fa, sa, _pa = _spawn_node("toffa", 2)
+    fb, sb, pb = _spawn_node("toffb", 2, overrides={"uigc.telemetry.tracing": False})
+    try:
+        fa.connect("127.0.0.1", pb)
+        probe = TestProbe(default_timeout_s=20.0)
+        probe_cell = sb.spawn_system_raw(_ProbeForwarder(probe), "probe-fwd")
+
+        class _Echo(AbstractBehavior):
+            def __init__(self, context):
+                super().__init__(context)
+
+            def on_message(self, msg):
+                probe_cell.tell(_Ping())
+                return self
+
+        worker = sb.spawn_root(Behaviors.setup_root(_Echo), "worker")
+        proxy = fa._proxy(sb.address, worker.cell.uid)
+        driver = sa.spawn_root(
+            Behaviors.setup_root(
+                lambda ctx: _Driver(ctx, ctx.engine.to_root_refob(proxy))
+            ),
+            "driver",
+        )
+        for _ in range(5):
+            driver.tell(_Ping())
+        for _ in range(5):
+            probe.expect_message_type(_Ping)  # traffic flows end to end
+        assert sb.telemetry is None
+        sends = [s for s in sa.telemetry.tracer.spans() if s["name"] == "send"]
+        assert sends  # A still traced its half
+    finally:
+        _terminate_all(sa, sb)
+
+
+# ------------------------------------------------------------------- #
+# Acceptance: 3-node chaos run, chrome trace + wake profile + metrics
+# ------------------------------------------------------------------- #
+
+
+def test_chaos_run_exports_causal_timeline_and_wake_profile(tmp_path):
+    """The ISSUE's acceptance scenario: three NodeFabrics with tracing,
+    metrics and the wake profiler on, seeded faults on the links, a
+    remote-held worker released so a GC wave terminates it.  The
+    exported Chrome trace must contain causally-linked spans from >= 2
+    distinct nodes covering send -> invoke -> gc_wave -> terminate; the
+    wake profile must attribute >= 4 named phases per wake; wave and
+    fault metrics must be nonzero."""
+    plan = FaultPlan(42)
+    overrides = {
+        "uigc.telemetry.metrics": True,
+        "uigc.telemetry.wake-profile": True,
+        "uigc.node.heartbeat-interval": 50,
+    }
+    fa, sa, pa = _spawn_node("chaosa", 3, overrides)
+    fb, sb, pb = _spawn_node("chaosb", 3, overrides)
+    fc, sc, pc = _spawn_node("chaosc", 3, overrides)
+    systems = (sa, sb, sc)
+    try:
+        for fabric in (fa, fb, fc):
+            fabric.set_fault_plan(plan)
+        # Bounded chaos the run must absorb WITHOUT skewing GC message
+        # balances (a dropped app send on a surviving link leaks its
+        # recv count until the link dies, by design): drop heartbeat
+        # frames (phi absorbs them; the seq layer reports the gaps) and
+        # duplicate app frames (discarded by the seq layer).
+        plan.drop(src=sa.address, dst=sb.address, kind="hb", prob=0.3, count=8)
+        plan.duplicate(src=sa.address, dst=sb.address, kind="app", prob=0.2, count=6)
+        fa.connect("127.0.0.1", pb)
+        fa.connect("127.0.0.1", pc)
+        fb.connect("127.0.0.1", pc)
+
+        probe = TestProbe(default_timeout_s=30.0)
+        probe_cell = sb.spawn_system_raw(_ProbeForwarder(probe), "probe-fwd")
+        driver = sa.spawn_root(
+            Behaviors.setup_root(lambda ctx: _Driver(ctx, None)), "driver"
+        )
+        driver_proxy = fb._proxy(sa.address, driver.cell.uid)
+        owner = sb.spawn_root(
+            Behaviors.setup_root(
+                lambda ctx: _Owner(
+                    ctx, _Probe(probe_cell), ctx.engine.to_root_refob(driver_proxy)
+                )
+            ),
+            "owner",
+        )
+        spawned = probe.expect_message_type(_Spawned)
+        owner.tell(_ShareMsg(None))  # hand the worker ref to A's driver
+        for _ in range(30):
+            driver.tell(_Ping())
+            time.sleep(0.005)
+        driver.tell(_Release())
+        owner.tell(_Release())  # both refs gone -> only a GC wave can kill it
+        stopped = probe.expect_message_type(_Stopped, timeout_s=30.0)
+        assert stopped.name == spawned.name
+        time.sleep(0.3)
+
+        # -- chrome trace: causally-linked spans from >= 2 nodes ------- #
+        tracers = [s.telemetry.tracer for s in systems]
+        doc = chrome_trace(tracers)
+        path = tmp_path / "trace.json"
+        path.write_text(json.dumps(doc))
+        parsed = json.loads(path.read_text())
+        spans = [e for e in parsed["traceEvents"] if e["ph"] == "X"]
+        by_id = {e["args"]["span_id"]: e for e in spans if "span_id" in e["args"]}
+        linked_pids = set()
+        chain_names = set()
+        for event in spans:
+            parent = event["args"].get("parent_id")
+            if parent and parent in by_id:
+                linked_pids.add(event["pid"])
+                linked_pids.add(by_id[parent]["pid"])
+                chain_names.add(event["name"])
+        assert len(linked_pids) >= 2, "causal links span fewer than 2 nodes"
+        names = {e["name"] for e in spans}
+        assert {"send", "invoke", "gc_wave", "terminate"} <= names, names
+        # the terminate chains to the wave that killed the worker
+        wave_ids = {
+            e["args"]["span_id"] for e in spans if e["name"] == "gc_wave"
+        }
+        terminates = [e for e in spans if e["name"] == "terminate"]
+        assert any(e["args"].get("parent_id") in wave_ids for e in terminates)
+        # cross-node flow arrows made it into the export
+        assert any(e.get("ph") == "s" for e in parsed["traceEvents"])
+
+        # -- wake profiler: >= 4 named phases per wake ----------------- #
+        profile = sb.telemetry.profiler.dump(str(tmp_path / "wake.json"))
+        assert profile["wakes"] > 0
+        for wake in profile["recent"]:
+            assert len(wake["phases"]) >= 4, wake
+            assert {"ingest", "fold", "trace", "sweep"} <= set(wake["phases"])
+        assert profile["phases"]["trace"]["total_s"] > 0
+
+        # -- metrics: nonzero wave + fault counters -------------------- #
+        text = prometheus_text(sb.telemetry.registry)
+        wave_count = re.search(
+            r"uigc_gc_wave_seconds_count\{[^}]*\} (\d+)", text
+        )
+        assert wave_count and int(wave_count.group(1)) > 0
+        garbage = re.search(r"uigc_gc_garbage_total(\{[^}]*\})? (\d+)", text)
+        assert garbage and int(garbage.group(2)) > 0
+        dropped_text = prometheus_text(sa.telemetry.registry)
+        dropped = re.search(
+            r"uigc_frames_dropped_total(\{[^}]*\})? (\d+)", dropped_text
+        )
+        assert dropped and int(dropped.group(2)) > 0, "fault metrics empty"
+    finally:
+        _terminate_all(*systems)

@@ -128,7 +128,8 @@ def main() -> None:
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline and not fabric._conns:
         time.sleep(0.05)
-    assert fabric._conns, "churn child never connected"
+    if not fabric._conns:
+        raise RuntimeError("churn child never connected")
 
     baseline = dict(stats)
     t0 = time.perf_counter()

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""telemetry-dump: render uigc telemetry as Prometheus text or JSON.
+
+Three sources, one output pipeline (build a metrics registry, render):
+
+- ``--from-jsonl PATH``  replay a persisted JSONL event log
+  (``uigc.telemetry.jsonl-path``) through the same event->metrics
+  bridge a live system uses, so an offline dump and a live scrape of
+  the same run agree;
+- ``--demo``             run a tiny in-process workload with telemetry
+  attached (spawn/churn/release under a fast collector) and dump what
+  it produced — the zero-to-metrics smoke;
+- ``--snapshot PATH``    pretty-print a recorder snapshot JSON file
+  (``events.recorder.snapshot()`` saved by your driver) as-is.
+
+Output: ``--format prom`` (default; Prometheus text exposition) or
+``--format json`` (the registry snapshot).  One document to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _registry(node: str):
+    from uigc_tpu.telemetry.metrics import EventMetricsBridge, MetricsRegistry
+
+    registry = MetricsRegistry(const_labels={"node": node})
+    return registry, EventMetricsBridge(registry)
+
+
+def dump_from_jsonl(path: str, fmt: str) -> int:
+    from uigc_tpu.telemetry.exporter import prometheus_text, replay_jsonl
+
+    registry, bridge = _registry(node=f"replay:{Path(path).name}")
+    n = 0
+    for name, fields in replay_jsonl(path):
+        bridge(name, fields)
+        n += 1
+    if n == 0:
+        print(f"telemetry-dump: no events in {path!r}", file=sys.stderr)
+        return 1
+    if fmt == "json":
+        print(json.dumps(registry.snapshot(), indent=2, sort_keys=True, default=repr))
+    else:
+        sys.stdout.write(prometheus_text(registry))
+    return 0
+
+
+def dump_demo(fmt: str) -> int:
+    from uigc_tpu import AbstractBehavior, ActorTestKit, Behaviors, NoRefs
+    from uigc_tpu.telemetry.exporter import prometheus_text
+
+    class Ping(NoRefs):
+        pass
+
+    class Worker(AbstractBehavior):
+        def on_message(self, msg):
+            return self
+
+    class Root(AbstractBehavior):
+        def __init__(self, context):
+            super().__init__(context)
+            self.workers = [
+                context.spawn(Behaviors.setup(Worker), f"w{i}") for i in range(8)
+            ]
+
+        def on_message(self, msg):
+            ctx = self.context
+            if isinstance(msg, Ping) and self.workers:
+                for worker in self.workers:
+                    worker.tell(Ping(), ctx)
+            elif self.workers:
+                ctx.release(*self.workers)
+                self.workers = []
+            return self
+
+    kit = ActorTestKit(
+        config={
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.telemetry.metrics": True,
+            "uigc.telemetry.wake-profile": True,
+        },
+        name="telemetry-demo",
+    )
+    try:
+        root = kit.spawn(Behaviors.setup_root(Root), "root")
+        for _ in range(50):
+            root.tell(Ping())
+        time.sleep(0.3)
+        root.tell(object())  # release branch
+        time.sleep(0.5)
+        telemetry = kit.system.telemetry
+        if fmt == "json":
+            doc = {
+                "metrics": telemetry.registry.snapshot(),
+                "wake_profile": telemetry.profiler.to_json(),
+            }
+            print(json.dumps(doc, indent=2, sort_keys=True, default=repr))
+        else:
+            sys.stdout.write(prometheus_text(telemetry.registry))
+    finally:
+        kit.shutdown()
+    return 0
+
+
+def dump_snapshot(path: str, fmt: str) -> int:
+    with open(path) as fh:
+        snap = json.load(fh)
+    if fmt == "json":
+        print(json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    # Render a recorder snapshot as gauges/counters: counts are
+    # monotone (counter-like), sums and duration stats become gauges.
+    lines = []
+    for name, count in sorted(snap.get("counts", {}).items()):
+        metric = "uigc_event_total{event=\"%s\"}" % name
+        lines.append(f"{metric} {count}")
+    for name, value in sorted(snap.get("sums", {}).items()):
+        lines.append('uigc_event_sum{field="%s"} %s' % (name, value))
+    for name, stat in sorted(snap.get("durations", {}).items()):
+        for key in ("n", "total_s", "max_s"):
+            lines.append(
+                'uigc_event_duration_%s{event="%s"} %s' % (key, name, stat[key])
+            )
+    sys.stdout.write("\n".join(lines) + "\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="telemetry-dump", description=__doc__.splitlines()[0]
+    )
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument("--from-jsonl", metavar="PATH", help="replay a JSONL event log")
+    source.add_argument(
+        "--demo", action="store_true", help="run a tiny workload and dump its metrics"
+    )
+    source.add_argument(
+        "--snapshot", metavar="PATH", help="render a saved recorder snapshot JSON"
+    )
+    parser.add_argument(
+        "--format",
+        choices=("prom", "json"),
+        default="prom",
+        help="output format (default: prom)",
+    )
+    args = parser.parse_args(argv)
+    if args.from_jsonl:
+        return dump_from_jsonl(args.from_jsonl, args.format)
+    if args.snapshot:
+        return dump_snapshot(args.snapshot, args.format)
+    return dump_demo(args.format)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -515,7 +515,11 @@ class Sanitizer:
     def _check_trace(self, n_real: int, compare: bool) -> None:
         with self._lock:
             self._reach_cache = None  # the trace compacts the oracle
-            n_oracle = self.oracle.trace(should_kill=False)
+            # Muted: the oracle re-runs the instrumented trace pipeline;
+            # letting it commit crgc.tracing/crgc.sweep would make every
+            # metrics consumer double-count the wave with oracle timings.
+            with events.recorder.suppressed():
+                n_oracle = self.oracle.trace(should_kill=False)
             self.checks += 1
         events.recorder.commit(
             events.ANALYSIS_CHECK,

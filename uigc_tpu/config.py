@@ -116,6 +116,35 @@ DEFAULTS: Dict[str, Any] = {
     # (consumed by the vector-clock race detector, analysis/race.py).
     # Requires the event recorder to be enabled as well.
     "uigc.analysis.sched-events": False,
+    # --- Telemetry (uigc_tpu/telemetry; the exportable layer above the
+    # in-process event counters — the reference stops at JFR events,
+    # PROFILING.md:1-10) ---
+    # Attach the metrics registry: typed counters/gauges/histograms
+    # populated from the event stream plus direct taps (shadow-graph
+    # size, mailbox depth, per-link phi).  Enables the event recorder.
+    "uigc.telemetry.metrics": False,
+    # Causal message tracing: trace/span ids stamped on every send,
+    # propagated across NodeFabric frames as an optional header
+    # (version-tolerant: peers without tracing ignore it), exportable as
+    # Chrome-trace/Perfetto JSON.  Off by default — it is per-message
+    # overhead.
+    "uigc.telemetry.tracing": False,
+    # Collector wake profiler: break each Bookkeeper wake into
+    # ingest/fold/trace/sweep/broadcast phases with device-vs-host time
+    # (hooks the tpu.device_trace / crgc.sweep events); dump BENCH-style
+    # JSON via system.telemetry.profiler.  Enables the event recorder.
+    "uigc.telemetry.wake-profile": False,
+    # Localhost HTTP exposition: serve /metrics (Prometheus text) and
+    # /metrics.json on 127.0.0.1.  -1 disables; 0 binds an ephemeral
+    # port (read it from system.telemetry.http.port).  A fixed port
+    # that is already bound (several systems sharing one config in one
+    # process) degrades to an ephemeral port instead of failing system
+    # construction.
+    "uigc.telemetry.http-port": -1,
+    # Persist every committed event as one JSON line to this path
+    # (replayable offline into RaceDetector.feed() and the violation
+    # summaries; see uigc_tpu/telemetry/exporter.py).  "" disables.
+    "uigc.telemetry.jsonl-path": "",
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,

@@ -966,25 +966,26 @@ class ArrayShadowGraph:
             # unpack_marks auto-invalidates the tracer on readback
             # failure, so a poisoned wake needs no handling here.
             mark = np.asarray(dec.unpack_marks(mark_w))
-            garbage, kill = trace_ops.garbage_and_kills_np(
-                snap_flags, snap_sup, mark
-            )
-            if garbage.shape[0] < self.capacity:
-                # capacity grew between launch and harvest: slots beyond
-                # the snapshot were interned after it, so they carry no
-                # verdict (not garbage) — pad so the sweep's edge scans
-                # index the grown arrays safely
-                pad = np.zeros(self.capacity - garbage.shape[0], bool)
-                garbage = np.concatenate([garbage, pad])
-                kill = np.concatenate([kill, pad])
-            garbage_slots = np.nonzero(garbage)[0]
-            kill_slots = np.nonzero(kill)[0]
-            if should_kill:
-                cells = self.cells
-                for slot in kill_slots.tolist():
-                    cells[slot].tell(StopMsg)
-            if garbage_slots.size:
-                self._free_slots_batch(garbage, garbage_slots)
+            with events.recorder.timed(events.SWEEP):
+                garbage, kill = trace_ops.garbage_and_kills_np(
+                    snap_flags, snap_sup, mark
+                )
+                if garbage.shape[0] < self.capacity:
+                    # capacity grew between launch and harvest: slots beyond
+                    # the snapshot were interned after it, so they carry no
+                    # verdict (not garbage) — pad so the sweep's edge scans
+                    # index the grown arrays safely
+                    pad = np.zeros(self.capacity - garbage.shape[0], bool)
+                    garbage = np.concatenate([garbage, pad])
+                    kill = np.concatenate([kill, pad])
+                garbage_slots = np.nonzero(garbage)[0]
+                kill_slots = np.nonzero(kill)[0]
+                if should_kill:
+                    cells = self.cells
+                    for slot in kill_slots.tolist():
+                        cells[slot].tell(StopMsg)
+                if garbage_slots.size:
+                    self._free_slots_batch(garbage, garbage_slots)
             ev.fields["num_garbage_actors"] = int(garbage_slots.size)
             ev.fields["num_live_actors"] = int(np.count_nonzero(mark))
         return int(garbage_slots.size)
@@ -999,19 +1000,23 @@ class ArrayShadowGraph:
         self._pending_wake = None
         with events.recorder.timed(events.TRACING) as ev:
             mark = self.compute_marks()
-            garbage, kill = trace_ops.garbage_and_kills_np(
-                self.flags, self.supervisor, mark
-            )
-            garbage_slots = np.nonzero(garbage)[0]
-            kill_slots = np.nonzero(kill)[0]
+            # The sweep (kill decisions + slot frees) nests in its own
+            # timed event so the wake profiler can attribute
+            # trace-vs-sweep time (telemetry/profile.py).
+            with events.recorder.timed(events.SWEEP):
+                garbage, kill = trace_ops.garbage_and_kills_np(
+                    self.flags, self.supervisor, mark
+                )
+                garbage_slots = np.nonzero(garbage)[0]
+                kill_slots = np.nonzero(kill)[0]
 
-            if should_kill:
-                cells = self.cells
-                for slot in kill_slots.tolist():
-                    cells[slot].tell(StopMsg)
+                if should_kill:
+                    cells = self.cells
+                    for slot in kill_slots.tolist():
+                        cells[slot].tell(StopMsg)
 
-            if garbage_slots.size:
-                self._free_slots_batch(garbage, garbage_slots)
+                if garbage_slots.size:
+                    self._free_slots_batch(garbage, garbage_slots)
 
             ev.fields["num_garbage_actors"] = int(garbage_slots.size)
             ev.fields["num_live_actors"] = int(np.count_nonzero(mark))

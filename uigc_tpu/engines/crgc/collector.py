@@ -20,6 +20,7 @@ trace.  Multi-node (num-nodes > 1, attached to a Fabric):
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from typing import TYPE_CHECKING, Any, Dict, Optional, Set
 
 from ...runtime.behaviors import RawBehavior
@@ -54,6 +55,11 @@ class _FinalizeEgresses:
 WAKEUP = _Wakeup()
 START_WAVE = _StartWave()
 FINALIZE_EGRESSES = _FinalizeEgresses()
+
+
+def _phase(wake: Any, name: str):
+    """Profiler phase bracket, or a no-op when no wake is active."""
+    return wake.phase(name) if wake is not None else nullcontext()
 
 
 class DeltaMsg:
@@ -300,69 +306,109 @@ class Bookkeeper(RawBehavior):
     # ------------------------------------------------------------- #
 
     def collect(self) -> int:
+        """One collector wake.  Observability wrapping (both optional,
+        both attached by ``telemetry.Telemetry``): the whole wake runs
+        inside a ``gc_wave`` span whose context becomes the causal
+        parent of the terminations it triggers, and the wake profiler
+        brackets the pipeline phases (ingest/fold/trace/broadcast here;
+        the sweep share is attributed from the ``crgc.sweep`` event the
+        backends emit inside their trace)."""
+        engine = self.engine
+        tel = engine.system.telemetry
+        tracer = tel.tracer if tel is not None and tel.tracer.enabled else None
+        prof = engine.wake_profiler
+        wake = prof.begin_wake() if prof is not None else None
+        count = n_garbage = 0
+        try:
+            if tracer is not None:
+                with tracer.span("gc_wave", node=engine.system.address) as span:
+                    tracer.note_wave(span.ctx)
+                    count, n_garbage = self._collect_inner(wake)
+                    span.args["entries"] = count
+                    span.args["garbage"] = n_garbage
+            else:
+                count, n_garbage = self._collect_inner(wake)
+        finally:
+            # A raising wake must still close its profiler accounting,
+            # or _active dangles and later sweep/device events are
+            # credited to a dead wake.
+            if wake is not None:
+                wake.end(entries=count, garbage=n_garbage)
+        self._after_wake(n_garbage)
+        return count
+
+    def _collect_inner(self, wake: Any) -> tuple:
+        """Drain, fold, trace.  Returns ``(num_entries, n_garbage)``."""
         engine = self.engine
         queue = engine.queue
         pool = engine.entry_pool
         count = 0
         multi = self.multi_node
         with events.recorder.timed(events.PROCESSING_ENTRIES) as ev:
-            # Packed plane first: its rows happened-before any object
-            # entries still in the queue for the same actors (the only
-            # object entries in packed mode are dead-letter accounting,
-            # which follows the dead actor's packed final flush).
             plane = engine.packed_plane
-            if plane is not None:
-                rows = plane.drain()
+            rows = None
+            with _phase(wake, "ingest"):
+                if plane is not None:
+                    rows = plane.drain()
+                batch = []
+                while True:
+                    try:
+                        entry = queue.popleft()
+                    except IndexError:
+                        break
+                    count += 1
+                    batch.append(entry)
+                    if multi:
+                        self.delta_graph.merge_entry(entry)
+                        if self.delta_graph.is_full():
+                            self.finalize_delta_graph(wake)
+            with _phase(wake, "fold"):
+                # Packed rows fold first: they happened-before any object
+                # entries drained for the same actors (the only object
+                # entries in packed mode are dead-letter accounting, which
+                # follows the dead actor's packed final flush).
                 if rows is not None:
                     count += rows.shape[0]
                     self.shadow_graph.merge_packed(rows)
-            batch = []
-            while True:
-                try:
-                    entry = queue.popleft()
-                except IndexError:
-                    break
-                count += 1
-                batch.append(entry)
-                if multi:
-                    self.delta_graph.merge_entry(entry)
-                    if self.delta_graph.is_full():
-                        self.finalize_delta_graph()
-            if batch:
-                merge_entries = getattr(self.shadow_graph, "merge_entries", None)
-                if merge_entries is not None:
-                    # Batched fold: flatten the whole drained queue, then
-                    # vectorized scatter-applies (ArrayShadowGraph).
-                    merge_entries(batch)
-                else:
+                if batch:
+                    merge_entries = getattr(self.shadow_graph, "merge_entries", None)
+                    if merge_entries is not None:
+                        # Batched fold: flatten the whole drained queue, then
+                        # vectorized scatter-applies (ArrayShadowGraph).
+                        merge_entries(batch)
+                    else:
+                        for entry in batch:
+                            self.shadow_graph.merge_entry(entry)
                     for entry in batch:
-                        self.shadow_graph.merge_entry(entry)
-                for entry in batch:
-                    entry.clean()
-                    pool.append(entry)
+                        entry.clean()
+                        pool.append(entry)
             if multi and self.delta_graph.non_empty():
-                self.finalize_delta_graph()
+                self.finalize_delta_graph(wake)
             ev.fields["num_entries"] = count
         self.total_entries += count
         graph = self.shadow_graph
-        if self.engine.pipelined and getattr(graph, "can_pipeline", False):
-            # Pipelined: sweep the previous wake's verdicts (if its
-            # device result landed), then dispatch the next wake and
-            # return — the device traces while the mutators keep
-            # folding (SURVEY §7; sound because CRGC garbage is
-            # monotone, see ArrayShadowGraph.launch_trace).  A wake
-            # whose result never lands is expired so a transport outage
-            # cannot deadlock collection forever.
-            n_garbage = 0
-            if graph.harvest_ready():
-                n_garbage = graph.harvest_trace(should_kill=True)
+        with _phase(wake, "trace"):
+            if self.engine.pipelined and getattr(graph, "can_pipeline", False):
+                # Pipelined: sweep the previous wake's verdicts (if its
+                # device result landed), then dispatch the next wake and
+                # return — the device traces while the mutators keep
+                # folding (SURVEY §7; sound because CRGC garbage is
+                # monotone, see ArrayShadowGraph.launch_trace).  A wake
+                # whose result never lands is expired so a transport outage
+                # cannot deadlock collection forever.
+                n_garbage = 0
+                if graph.harvest_ready():
+                    n_garbage = graph.harvest_trace(should_kill=True)
+                else:
+                    graph.expire_stalled_wake(
+                        max(30.0, self.engine.wakeup_interval_ms / 1000.0 * 20)
+                    )
+                graph.launch_trace()
             else:
-                graph.expire_stalled_wake(
-                    max(30.0, self.engine.wakeup_interval_ms / 1000.0 * 20)
-                )
-            graph.launch_trace()
-        else:
-            n_garbage = graph.trace(should_kill=True)
+                n_garbage = graph.trace(should_kill=True)
+        return count, n_garbage
+
+    def _after_wake(self, n_garbage: int) -> None:
         # Cascade acceleration: a wake that killed actors triggers more
         # facts (death flushes, released refs) that usually make MORE
         # actors collectable — a released tree dies level by level.  A
@@ -375,7 +421,6 @@ class Bookkeeper(RawBehavior):
         # LocalGC.scala:213) — at its scale the cascade fits one wake.
         if n_garbage > 0 and self.started:
             self.cell.tell(WAKEUP)
-        return count
 
     def diagnostic_dump(self) -> Dict[str, Any]:
         """Structured collector diagnostics (the reference's println
@@ -394,14 +439,19 @@ class Bookkeeper(RawBehavior):
             out["live_set"] = g.investigate_live_set()
         return out
 
-    def finalize_delta_graph(self) -> None:
-        """(reference: LocalGC.scala:191-196)"""
-        fabric = self.engine.system.fabric
-        msg = DeltaMsg(self.delta_graph_id, self.delta_graph)
-        for gc in self.remote_gcs.values():
-            fabric.control_send(self.engine.system, gc, msg)
-        self.delta_graph_id += 1
-        self.delta_graph = DeltaGraph(self.engine.system.address, self.engine.crgc_context)
+    def finalize_delta_graph(self, wake: Any = None) -> None:
+        """(reference: LocalGC.scala:191-196).  Profiled as the wake's
+        ``broadcast`` phase — the nested-phase accounting keeps it out
+        of the enclosing ingest bracket."""
+        with _phase(wake, "broadcast"):
+            fabric = self.engine.system.fabric
+            msg = DeltaMsg(self.delta_graph_id, self.delta_graph)
+            for gc in self.remote_gcs.values():
+                fabric.control_send(self.engine.system, gc, msg)
+            self.delta_graph_id += 1
+            self.delta_graph = DeltaGraph(
+                self.engine.system.address, self.engine.crgc_context
+            )
 
     def stop_timers(self) -> None:
         for key in self._timer_keys:

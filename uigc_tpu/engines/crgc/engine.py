@@ -192,6 +192,11 @@ class CRGC(Engine):
         app_msg = AppMsg(msg, refs)
         target = ref.target
         fabric = self.system.fabric
+        tel = self.system.telemetry
+        if tel is not None and tel.tracer.enabled:
+            app_msg.trace_ctx = tel.tracer.on_send(
+                target=target.path, uid=target.uid
+            )
         tap = self.tap
         if tap is not None:
             tap.on_send(

@@ -12,12 +12,17 @@ class AppMsg(GCMessage):
     ``window_id`` is stamped by the egress when the message crosses a node
     boundary (reference: GCMessage.scala:7-13, Gateways.scala:83)."""
 
-    __slots__ = ("payload", "_refs", "window_id", "external")
+    __slots__ = ("payload", "_refs", "window_id", "external", "trace_ctx")
 
     def __init__(self, payload: Any, refs: Iterable[Refob], external: bool = False):
         self.payload = payload
         self._refs: Tuple[Refob, ...] = tuple(refs)
         self.window_id = -1
+        #: causal-tracing context, a ``(trace_id, span_id)`` pair or
+        #: None (uigc_tpu/telemetry/tracing.py); stamped by the engine's
+        #: send path when tracing is on, and carried across node
+        #: boundaries in the transport frame header.
+        self.trace_ctx = None
         #: True for messages wrapped by the root adapter (sent by
         #: unmanaged code).  External sends carry no sender-side
         #: send-count, so counting them as received would leave the

@@ -254,23 +254,26 @@ class ShadowGraph:
 
             num_garbage = 0
             num_live = 0
-            for shadow in self.from_set:
-                if shadow.mark != marked:
-                    num_garbage += 1
-                    self.shadow_map.pop(shadow.self_cell, None)
-                    if (
-                        should_kill
-                        and shadow.is_local
-                        and not shadow.is_halted
-                        and shadow.supervisor is not None
-                        and shadow.supervisor.mark == marked
-                    ):
-                        shadow.self_cell.tell(StopMsg)
-                else:
-                    num_live += 1
+            # The sweep in its own timed event, for the wake profiler's
+            # trace-vs-sweep attribution (telemetry/profile.py).
+            with events.recorder.timed(events.SWEEP):
+                for shadow in self.from_set:
+                    if shadow.mark != marked:
+                        num_garbage += 1
+                        self.shadow_map.pop(shadow.self_cell, None)
+                        if (
+                            should_kill
+                            and shadow.is_local
+                            and not shadow.is_halted
+                            and shadow.supervisor is not None
+                            and shadow.supervisor.mark == marked
+                        ):
+                            shadow.self_cell.tell(StopMsg)
+                    else:
+                        num_live += 1
 
-            self.from_set = to_set
-            self.marked = not marked
+                self.from_set = to_set
+                self.marked = not marked
             ev.fields["num_garbage_actors"] = num_garbage
             ev.fields["num_live_actors"] = num_live
         return num_garbage

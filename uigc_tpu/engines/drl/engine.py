@@ -77,12 +77,14 @@ class DrlRefob(Refob):
 class DrlAppMsg(GCMessage):
     """(reference: drl/GCMessage.scala:7-11)"""
 
-    __slots__ = ("payload", "token", "_refs")
+    __slots__ = ("payload", "token", "_refs", "trace_ctx")
 
     def __init__(self, payload: Any, token: Optional[Token], refs: Iterable[Refob]):
         self.payload = payload
         self.token = token
         self._refs = tuple(refs)
+        #: causal-tracing context (uigc_tpu/telemetry/tracing.py).
+        self.trace_ctx = None
 
     @property
     def refs(self) -> Tuple[Refob, ...]:
@@ -294,7 +296,13 @@ class DRL(Engine):
         """(reference: drl/DRL.scala:148-160)"""
         if self.tap is not None:
             self.tap.on_send(ref.target)
-        ref.target.tell(DrlAppMsg(msg, ref.token, refs))
+        app_msg = DrlAppMsg(msg, ref.token, refs)
+        tel = self.system.telemetry
+        if tel is not None and tel.tracer.enabled:
+            app_msg.trace_ctx = tel.tracer.on_send(
+                target=ref.target.path, uid=ref.target.uid
+            )
+        ref.target.tell(app_msg)
         state.inc_sent(ref.token)
 
     def on_message(

@@ -69,6 +69,10 @@ class Engine:
         self.system = system
         #: optional :class:`EngineTap` installed by the sanitizer.
         self.tap: Optional[EngineTap] = None
+        #: optional wake profiler (uigc_tpu/telemetry/profile.py),
+        #: installed by Telemetry.attach; engines with a periodic
+        #: collector consult it per wake.
+        self.wake_profiler: Optional[Any] = None
 
     # -- Root-actor support ------------------------------------------- #
 

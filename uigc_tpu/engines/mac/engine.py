@@ -53,7 +53,7 @@ class MacRefob(Refob):
 class MacAppMsg(GCMessage):
     """(reference: MAC.scala:30-31)"""
 
-    __slots__ = ("payload", "_refs", "is_self_msg", "external")
+    __slots__ = ("payload", "_refs", "is_self_msg", "external", "trace_ctx")
 
     def __init__(
         self,
@@ -68,6 +68,8 @@ class MacAppMsg(GCMessage):
         #: wrapped by the root adapter (sent by unmanaged code): carries
         #: no sender-side accounting, so observation taps skip it.
         self.external = external
+        #: causal-tracing context (uigc_tpu/telemetry/tracing.py).
+        self.trace_ctx = None
 
     @property
     def refs(self) -> Tuple[Refob, ...]:
@@ -260,7 +262,13 @@ class MAC(Engine):
             state.pending_self_messages += 1
         if self.tap is not None:
             self.tap.on_send(ref.target)
-        ref.target.tell(MacAppMsg(msg, refs, is_self_msg))
+        app_msg = MacAppMsg(msg, refs, is_self_msg)
+        tel = self.system.telemetry
+        if tel is not None and tel.tracer.enabled:
+            app_msg.trace_ctx = tel.tracer.on_send(
+                target=ref.target.path, uid=ref.target.uid
+            )
+        ref.target.tell(app_msg)
 
     def on_message(
         self, msg: GCMessage, state: MacState, ctx: "ActorContext"

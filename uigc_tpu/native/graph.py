@@ -261,13 +261,17 @@ class NativeShadowGraph:
                     ctypes.byref(n_live),
                 )
             )
-            if should_kill:
-                for aid in kill_ids[: n_kill.value]:
-                    self._cell_of_id[int(aid)].tell(StopMsg)
-            for aid in garbage_ids[:n_garbage]:
-                cell = self._cell_of_id.pop(int(aid), None)
-                if cell is not None:
-                    self._id_of_cell.pop(cell, None)
+            # Host-side sweep (the C trace already freed its own state)
+            # in its own timed event for the wake profiler's
+            # trace-vs-sweep attribution (telemetry/profile.py).
+            with events.recorder.timed(events.SWEEP):
+                if should_kill:
+                    for aid in kill_ids[: n_kill.value]:
+                        self._cell_of_id[int(aid)].tell(StopMsg)
+                for aid in garbage_ids[:n_garbage]:
+                    cell = self._cell_of_id.pop(int(aid), None)
+                    if cell is not None:
+                        self._id_of_cell.pop(cell, None)
             ev.fields["num_garbage_actors"] = n_garbage
             ev.fields["num_live_actors"] = int(n_live.value)
         return n_garbage

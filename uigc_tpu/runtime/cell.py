@@ -288,8 +288,27 @@ class ActorCell:
     # ------------------------------------------------------------------ #
 
     def _invoke(self, msg: Any) -> None:
-        """Deliver one message through the engine sandwich (reference:
-        AbstractBehavior.scala:16-31)."""
+        """Deliver one message, wrapped in an ``invoke`` span when the
+        message carries a trace context (telemetry/tracing.py) — the
+        span brackets the engine sandwich AND sets the thread's current
+        context, so sends issued by the behavior chain causally."""
+        tel = self.system.telemetry
+        if tel is not None and tel.tracer.enabled:
+            ctx = tel.tracer.adopt(getattr(msg, "trace_ctx", None))
+            if ctx is not None:
+                with tel.tracer.span(
+                    "invoke",
+                    parent=ctx,
+                    path=self.path,
+                    uid=self.uid,
+                    msg=type(getattr(msg, "payload", msg)).__name__,
+                ):
+                    self._invoke_inner(msg)
+                return
+        self._invoke_inner(msg)
+
+    def _invoke_inner(self, msg: Any) -> None:
+        """The engine sandwich (reference: AbstractBehavior.scala:16-31)."""
         behavior = self.behavior
         if not self.is_managed:
             try:
@@ -434,6 +453,19 @@ class ActorCell:
                 cell=self.uid,
                 path=self.path,
                 thread=threading.get_ident(),
+            )
+        tel = self.system.telemetry
+        if tel is not None and tel.tracer.enabled:
+            # Causal parent: the span this stop was processed inside
+            # (a traced message whose handler stopped us), else the
+            # collector wave whose StopMsg — a singleton that cannot
+            # carry per-send context — issued the kill.
+            tracer = tel.tracer
+            tracer.instant(
+                "terminate",
+                parent=tracer.current() or tracer.last_wave,
+                path=self.path,
+                uid=self.uid,
             )
         if dropped:
             self.system.record_dead_letters_dropped(self, dropped)

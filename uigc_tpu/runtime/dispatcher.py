@@ -16,16 +16,28 @@ import threading
 import traceback
 from typing import Any, Callable, Dict, Optional
 
+from ..utils import events
+
 
 class Dispatcher:
-    """Fixed worker pool executing actor batches from a shared run queue."""
+    """Fixed worker pool executing actor batches from a shared run queue.
+
+    ``origin`` (the owning system's address) tags every worker thread's
+    committed events so per-node telemetry consumers can scope a shared
+    process-wide event stream (utils/events.py set_thread_origin)."""
 
     _SHUTDOWN = object()
 
-    def __init__(self, num_workers: int, name: str = "uigc-dispatcher"):
+    def __init__(
+        self,
+        num_workers: int,
+        name: str = "uigc-dispatcher",
+        origin: Optional[str] = None,
+    ):
         self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
         self._workers = []
         self._shutdown = False
+        self._origin = origin
         for i in range(num_workers):
             t = threading.Thread(
                 target=self._run, name=f"{name}-{i}", daemon=True
@@ -38,6 +50,7 @@ class Dispatcher:
             self._queue.put(runnable)
 
     def _run(self) -> None:
+        events.set_thread_origin(self._origin)
         while True:
             item = self._queue.get()
             if item is Dispatcher._SHUTDOWN:
@@ -61,9 +74,10 @@ class PinnedDispatcher:
 
     _SHUTDOWN = object()
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, origin: Optional[str] = None):
         self._queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
         self._shutdown = False
+        self._origin = origin
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
@@ -72,6 +86,7 @@ class PinnedDispatcher:
             self._queue.put(runnable)
 
     def _run(self) -> None:
+        events.set_thread_origin(self._origin)
         while True:
             item = self._queue.get()
             if item is PinnedDispatcher._SHUTDOWN:
@@ -94,12 +109,13 @@ class TimerService:
     ``timers.startTimerWithFixedDelay``).
     """
 
-    def __init__(self, name: str = "uigc-timers"):
+    def __init__(self, name: str = "uigc-timers", origin: Optional[str] = None):
         self._heap: list = []
         self._cond = threading.Condition()
         self._cancelled: Dict[Any, bool] = {}
         self._counter = itertools.count()
         self._shutdown = False
+        self._origin = origin
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
 
@@ -138,6 +154,7 @@ class TimerService:
     def _run(self) -> None:
         import time
 
+        events.set_thread_origin(self._origin)
         while True:
             with self._cond:
                 if self._shutdown:

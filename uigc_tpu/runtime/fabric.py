@@ -362,3 +362,9 @@ class Fabric:
     def flush(self, timeout_s: float = 10.0) -> bool:
         """Wait until the transit queue is drained (tests)."""
         return self._idle.wait(timeout_s)
+
+    def queue_depth(self) -> int:
+        """Messages currently in async transit — the telemetry gauge
+        tap (``uigc_fabric_transit_depth``)."""
+        with self._cv:
+            return len(self._queue)

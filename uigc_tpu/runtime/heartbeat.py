@@ -111,8 +111,12 @@ class HeartbeatMonitor:
         on_down: Callable[[str, float], None],
         threshold: float = 8.0,
         acceptable_pause_s: float = 0.5,
+        origin: Optional[str] = None,
     ):
         self.interval_s = interval_s
+        #: event-origin tag for the monitor's threads (the owning
+        #: node's address; see utils/events.py set_thread_origin)
+        self.origin = origin
         self._peers = peers
         self._ping = ping
         self._on_down = on_down
@@ -154,6 +158,13 @@ class HeartbeatMonitor:
     def phi(self, address: str) -> float:
         return self.detector_for(address).phi()
 
+    def phis(self) -> Dict[str, float]:
+        """Current suspicion level per watched peer — the telemetry
+        gauge tap (``uigc_link_phi``); sampled lazily at scrape time."""
+        with self._lock:
+            detectors = dict(self._detectors)
+        return {address: det.phi() for address, det in detectors.items()}
+
     # ------------------------------------------------------------- #
 
     def start(self) -> None:
@@ -168,6 +179,7 @@ class HeartbeatMonitor:
         self._stop.set()
 
     def _loop(self) -> None:
+        events.set_thread_origin(self.origin)
         while not self._stop.wait(self.interval_s):
             try:
                 self._tick()
@@ -217,6 +229,7 @@ class HeartbeatMonitor:
             self._ping_thread.start()
 
     def _ping_round(self, addresses: List[str]) -> None:
+        events.set_thread_origin(self.origin)
         for address in addresses:
             try:
                 self._ping(address)

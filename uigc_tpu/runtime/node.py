@@ -338,6 +338,7 @@ class NodeFabric:
                 threshold=config.get_float("uigc.node.phi-threshold"),
                 acceptable_pause_s=config.get_int("uigc.node.heartbeat-pause")
                 / 1000.0,
+                origin=self.address,
             )
             self._hb.start()
 
@@ -588,6 +589,9 @@ class NodeFabric:
     # ------------------------------------------------------------- #
 
     def _recv_loop(self, conn: _Conn) -> None:
+        # Transport threads belong to this node: tag their events so
+        # per-node telemetry consumers can scope the shared recorder.
+        events.set_thread_origin(self.address or None)
         while True:
             frame = conn.recv()
             if frame is None:
@@ -661,6 +665,7 @@ class NodeFabric:
         self._declare_dead(address, "eof")
 
     def _reconnect_loop(self, address: str, st: _PeerState, old_conn: Optional[_Conn]) -> None:
+        events.set_thread_origin(self.address or None)
         try:
             for attempt in range(self._reconnect_retries):
                 time.sleep(self._reconnect_backoff_s * (2**attempt))
@@ -817,12 +822,22 @@ class NodeFabric:
         conn = self._conn_for(dst_address)
         if conn is None:
             return
+        # Causal-tracing header (telemetry/tracing.py): the context the
+        # engine stamped on the envelope also rides the frame, OUTSIDE
+        # the payload bytes, so the receiver can adopt it before (and
+        # regardless of) payload decode.  Peers without tracing ignore
+        # the extra element — see _on_frame's tolerant unpack.
+        header = wire.encode_trace_header(msg)
         link = self._out_link(dst_address)
         with link.send_lock:
             if link.egress is not None:
                 link.egress.on_message(target, msg)
             payload = wire.encode_message(msg)
-            self._send_frame(dst_address, ("app", target.uid, payload), conn)
+            if header is not None:
+                frame = ("app", target.uid, payload, header)
+            else:
+                frame = ("app", target.uid, payload)
+            self._send_frame(dst_address, frame, conn)
 
     def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
         conn = self._conn_for(dst_address)
@@ -873,8 +888,18 @@ class NodeFabric:
     def _on_frame(self, from_address: str, frame: tuple) -> None:
         kind = frame[0]
         if kind == "app":
-            _, uid, payload = frame
+            # Tolerant unpack: the frame is (kind, uid, payload) with an
+            # optional trailing trace header — never destructure to a
+            # fixed arity, so frames from peers with or without tracing
+            # (or with future extra elements) all decode.
+            uid, payload = frame[1], frame[2]
             msg = wire.decode_message(self, payload)
+            tel = self.system.telemetry
+            if tel is not None and tel.tracer.enabled:
+                wire.apply_trace_header(
+                    msg,
+                    wire.decode_trace_header(frame[3] if len(frame) > 3 else None),
+                )
             link = self._in_link(from_address)
             if link.drop_filter is not None and link.drop_filter(msg):
                 return

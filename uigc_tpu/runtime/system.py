@@ -75,9 +75,11 @@ class ActorSystem:
         #: its hot path, so it is a plain attribute, not a config lookup.
         self.sched_events = self.config.get_bool("uigc.analysis.sched-events")
         self.dispatcher = Dispatcher(
-            self.config.get_int("uigc.runtime.num-workers"), name=f"{name}-dispatcher"
+            self.config.get_int("uigc.runtime.num-workers"),
+            name=f"{name}-dispatcher",
+            origin=self.address,
         )
-        self.timers = TimerService(name=f"{name}-timers")
+        self.timers = TimerService(name=f"{name}-timers", origin=self.address)
         self._pinned: list = []
         self._cells: Dict[int, ActorCell] = {}
         # Weak uid -> cell map covering stopped actors too: the wire
@@ -87,6 +89,11 @@ class ActorSystem:
         self._cells_lock = threading.Lock()
         self.dead_letters = 0
         self._terminated = threading.Event()
+        #: Telemetry subsystem (uigc_tpu/telemetry), attached below when
+        #: any ``uigc.telemetry.*`` key is on.  Declared BEFORE the
+        #: guardians/engine exist: dispatcher threads read this
+        #: attribute as soon as the first cell processes a message.
+        self.telemetry: Optional[Any] = None
 
         # Top-level guardians (raw).
         self._system_guardian = self._make_raw_cell("system", None)
@@ -106,6 +113,22 @@ class ActorSystem:
             from ..analysis import Sanitizer
 
             self.sanitizer = Sanitizer.attach(self)
+
+        # Telemetry attach: metrics registry + exporters, causal tracer,
+        # collector wake profiler.  The runtime's hot paths read
+        # ``system.telemetry`` directly (None = zero overhead).  Inline
+        # key check so the package (http.server etc.) is only imported
+        # when some telemetry is actually switched on.
+        if (
+            self.config.get_bool("uigc.telemetry.metrics")
+            or self.config.get_bool("uigc.telemetry.tracing")
+            or self.config.get_bool("uigc.telemetry.wake-profile")
+            or self.config.get_int("uigc.telemetry.http-port") >= 0
+            or bool(self.config.get_string("uigc.telemetry.jsonl-path"))
+        ):
+            from ..telemetry import Telemetry
+
+            self.telemetry = Telemetry.attach(self)
 
         if fabric is not None:
             fabric.register_system(self)
@@ -172,7 +195,9 @@ class ActorSystem:
         path; reference: CRGC.scala:54-58 uses a pinned dispatcher)."""
         dispatcher = None
         if pinned:
-            dispatcher = PinnedDispatcher(f"{self.name}-{name}-pinned")
+            dispatcher = PinnedDispatcher(
+                f"{self.name}-{name}-pinned", origin=self.address
+            )
             self._pinned.append(dispatcher)
         cell = ActorCell(
             self,
@@ -274,6 +299,8 @@ class ActorSystem:
         self.dispatcher.shutdown()
         if self.fabric is not None:
             self.fabric.unregister_system(self)
+        if self.telemetry is not None:
+            self.telemetry.close()
         self._terminated.set()
 
     def when_terminated(self, timeout_s: Optional[float] = None) -> bool:

@@ -138,3 +138,46 @@ def encode_message(msg: Any) -> bytes:
 
 def decode_message(fabric: "Fabric", data: bytes) -> Any:
     return _Unpickler(io.BytesIO(data), fabric).load()
+
+
+# ------------------------------------------------------------------- #
+# Trace-context headers (uigc_tpu/telemetry/tracing.py)
+#
+# A traced message carries its causal context OUTSIDE the payload
+# bytes, as an optional trailing element of the transport's app frame:
+# ``("app", uid, payload)`` becomes ``("app", uid, payload, header)``.
+# Keeping it out of the pickled body means the header survives payload
+# corruption, costs nothing when tracing is off, and — critically — is
+# version-tolerant: a receiver ignores headers it does not understand
+# and tolerates frames that do not carry one (a peer with tracing off,
+# or an older frame layout).
+# ------------------------------------------------------------------- #
+
+
+def encode_trace_header(msg: Any) -> Any:
+    """The wire header for a message's trace context, or None.  The
+    envelope convention is a ``trace_ctx`` attribute holding a
+    ``(trace_id, span_id)`` int pair (all three engines' app envelopes
+    carry the slot)."""
+    return getattr(msg, "trace_ctx", None)
+
+
+def decode_trace_header(obj: Any) -> Any:
+    """Validate a received header; anything unrecognizable is treated
+    as absent, never an error."""
+    if obj is None:
+        return None
+    from ..telemetry.tracing import decode_header
+
+    return decode_header(obj)
+
+
+def apply_trace_header(msg: Any, header: Any) -> None:
+    """Stamp a validated header onto a decoded message (best effort —
+    envelopes without the slot simply stay untraced)."""
+    if header is None:
+        return
+    try:
+        msg.trace_ctx = header
+    except AttributeError:
+        pass

@@ -1,0 +1,131 @@
+"""Telemetry: the exportable observability layer.
+
+The event recorder (:mod:`uigc_tpu.utils.events`) is an in-process
+counter sink — nothing can be scraped, correlated across nodes, or
+attributed to a single GC wave.  This package is the subsystem on top
+(see GUIDE.md "Observability"):
+
+- :mod:`uigc_tpu.telemetry.metrics` — typed registry (counters, gauges,
+  bounded-bucket histograms) populated from recorder listeners plus
+  direct taps on live runtime state;
+- :mod:`uigc_tpu.telemetry.tracing` — causal message tracing with
+  trace/span ids propagated through ``NodeFabric`` frame headers,
+  exported as Chrome-trace/Perfetto JSON;
+- :mod:`uigc_tpu.telemetry.profile` — the collector wake profiler
+  (ingest/fold/trace/sweep/broadcast phases, device-vs-host time);
+- :mod:`uigc_tpu.telemetry.exporter` — Prometheus text exposition over
+  a localhost HTTP handle, plus JSONL event persistence whose replay
+  feeds ``RaceDetector.feed()`` and the violation record offline.
+
+Everything is off by default and attached per-system from the
+``uigc.telemetry.*`` config keys; :class:`Telemetry` is the composition
+root (`system.telemetry`).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any, List, Optional
+
+from ..utils import events
+from .exporter import (
+    JsonlEventSink,
+    MetricsHTTPServer,
+    prometheus_text,
+    replay_jsonl,
+    replay_violations,
+)
+from .metrics import EventMetricsBridge, MetricsRegistry, install_system_gauges
+from .profile import WakeProfiler
+from .tracing import Tracer, chrome_trace, write_chrome_trace
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..runtime.system import ActorSystem
+
+__all__ = [
+    "Telemetry",
+    "MetricsRegistry",
+    "EventMetricsBridge",
+    "Tracer",
+    "WakeProfiler",
+    "MetricsHTTPServer",
+    "JsonlEventSink",
+    "prometheus_text",
+    "chrome_trace",
+    "write_chrome_trace",
+    "replay_jsonl",
+    "replay_violations",
+]
+
+
+class Telemetry:
+    """Per-system composition of the telemetry parts, driven by config.
+
+    Attach order matters only in that listeners register before any
+    workload runs; the runtime reads ``system.telemetry`` lazily on its
+    hot paths (one attribute check when telemetry is off)."""
+
+    def __init__(self, system: "ActorSystem"):
+        self.system = system
+        config = system.config
+        self.registry: Optional[MetricsRegistry] = None
+        self.tracer = Tracer(
+            system.address, enabled=config.get_bool("uigc.telemetry.tracing")
+        )
+        self.profiler: Optional[WakeProfiler] = None
+        self.http: Optional[MetricsHTTPServer] = None
+        self.jsonl: Optional[JsonlEventSink] = None
+        self._listeners: List[Any] = []
+
+        metrics_on = config.get_bool("uigc.telemetry.metrics")
+        profile_on = config.get_bool("uigc.telemetry.wake-profile")
+        http_port = config.get_int("uigc.telemetry.http-port")
+        jsonl_path = config.get_string("uigc.telemetry.jsonl-path")
+
+        if metrics_on or http_port >= 0:
+            self.registry = MetricsRegistry(const_labels={"node": system.address})
+            install_system_gauges(self.registry, system)
+        if metrics_on:
+            bridge = EventMetricsBridge(self.registry, node=system.address)
+            self._listeners.append(bridge)
+        if profile_on:
+            self.profiler = WakeProfiler(system.address)
+            self._listeners.append(self.profiler)
+            engine = getattr(system, "engine", None)
+            if engine is not None:
+                engine.wake_profiler = self.profiler
+        if jsonl_path:
+            self.jsonl = JsonlEventSink(jsonl_path)
+            self._listeners.append(self.jsonl)
+        if http_port >= 0:
+            self.http = MetricsHTTPServer(self.registry, port=http_port)
+
+        if self._listeners:
+            # Listener-fed parts need the process recorder live.
+            events.recorder.enable()
+            for listener in self._listeners:
+                events.recorder.add_listener(listener)
+
+    # ------------------------------------------------------------- #
+
+    @classmethod
+    def attach(cls, system: "ActorSystem") -> "Telemetry":
+        # The "is any telemetry key on" gate lives inline in
+        # runtime/system.py (the one caller), so this package is not
+        # imported at all for un-instrumented systems.
+        return cls(system)
+
+    def close(self) -> None:
+        """Detach listeners and release external handles.  The process
+        recorder stays enabled — other systems may still be feeding it."""
+        for listener in self._listeners:
+            events.recorder.remove_listener(listener)
+        self._listeners = []
+        engine = getattr(self.system, "engine", None)
+        if engine is not None and engine.wake_profiler is self.profiler:
+            engine.wake_profiler = None
+        if self.http is not None:
+            self.http.close()
+            self.http = None
+        if self.jsonl is not None:
+            self.jsonl.close()
+            self.jsonl = None

@@ -1,0 +1,199 @@
+"""Exporters: Prometheus text exposition, localhost HTTP, JSONL events.
+
+Three ways out of the process:
+
+- :func:`prometheus_text` renders a :class:`MetricsRegistry` in the
+  Prometheus text exposition format (``# HELP``/``# TYPE`` headers,
+  ``name{labels} value`` samples, cumulative ``_bucket{le=...}``
+  histogram series).
+- :class:`MetricsHTTPServer` serves that text on ``127.0.0.1`` at
+  ``/metrics`` (plus a JSON snapshot at ``/metrics.json``) from a
+  daemon thread — the minimal scrape handle, deliberately loopback-only.
+- :class:`JsonlEventSink` persists every committed recorder event as
+  one JSON line; :func:`replay_jsonl` streams a written file back as
+  ``(name, fields)`` pairs, the exact shape
+  :meth:`uigc_tpu.analysis.race.RaceDetector.feed` ingests, so a
+  production event log replays into the race detector (and the
+  sanitizer's violation record, :func:`replay_violations`) offline.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Iterator, List, Optional, TextIO, Tuple
+
+from .metrics import MetricsRegistry
+
+# ------------------------------------------------------------------- #
+# Prometheus text exposition
+# ------------------------------------------------------------------- #
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _render_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{name}="{_escape_label_value(value)}"' for name, value in labels
+    )
+    return "{" + inner + "}"
+
+
+def _render_value(value: float) -> str:
+    """Exposition-format value: integers bare, floats via repr, and the
+    non-finite spellings the format defines — a user callback gauge
+    returning inf/NaN must not kill the whole scrape."""
+    value = float(value)
+    if not math.isfinite(value):
+        if math.isnan(value):
+            return "NaN"
+        return "+Inf" if value > 0 else "-Inf"
+    return str(int(value)) if value == int(value) else repr(value)
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    """Render every metric in the registry as Prometheus exposition
+    text (version 0.0.4)."""
+    lines: List[str] = []
+    seen_header = set()
+    for metric, suffix, labels, value in registry.collect():
+        if metric.name not in seen_header:
+            seen_header.add(metric.name)
+            if metric.help_text:
+                lines.append(f"# HELP {metric.name} {metric.help_text}")
+            lines.append(f"# TYPE {metric.name} {metric.kind}")
+        lines.append(
+            f"{metric.name}{suffix}{_render_labels(labels)} {_render_value(value)}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------------- #
+# Localhost HTTP handle
+# ------------------------------------------------------------------- #
+
+
+class MetricsHTTPServer:
+    """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` on a
+    loopback port from a daemon thread.  ``port=0`` binds an ephemeral
+    port; read the bound one from :attr:`port`."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.registry = registry
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                if self.path.startswith("/metrics.json"):
+                    body = json.dumps(outer.registry.snapshot(), default=repr)
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = prometheus_text(outer.registry)
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *args: Any) -> None:
+                pass  # scrape traffic must not spam stderr
+
+        try:
+            self._server = ThreadingHTTPServer((host, port), _Handler)
+        except OSError:
+            if port == 0:
+                raise
+            # A fixed port already bound (several systems sharing one
+            # config dict in one process): degrade to an ephemeral port
+            # instead of failing system construction.
+            self._server = ThreadingHTTPServer((host, 0), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="uigc-telemetry-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ------------------------------------------------------------------- #
+# JSONL event persistence + replay
+# ------------------------------------------------------------------- #
+
+
+class JsonlEventSink:
+    """Recorder listener appending one JSON object per committed event:
+    ``{"event": <name>, ...fields}``.  Values that are not JSON-native
+    degrade to ``repr`` rather than breaking the commit path."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        # Line-buffered: a crashed/killed process loses at most one torn
+        # line, not an 8KB block of the events leading up to the crash —
+        # which are exactly the ones offline replay needs.
+        self._fh: Optional[TextIO] = open(path, "a", buffering=1)
+
+    def __call__(self, name: str, fields: Dict[str, Any]) -> None:
+        line = json.dumps(dict(fields, event=name), default=repr)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+
+
+def replay_jsonl(path: str) -> Iterator[Tuple[str, Dict[str, Any]]]:
+    """Stream a JSONL event log back as ``(name, fields)`` pairs —
+    feedable directly to ``RaceDetector.feed()`` or an
+    :class:`~uigc_tpu.telemetry.metrics.EventMetricsBridge`.  Damaged
+    lines (truncated tail of a crashed process) are skipped, not fatal."""
+    with open(path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                obj = json.loads(raw)
+            except ValueError:
+                continue
+            name = obj.pop("event", None)
+            if isinstance(name, str):
+                yield name, obj
+
+
+def replay_violations(path: str) -> List[Dict[str, Any]]:
+    """Offline sanitizer view of a persisted event log: the
+    ``analysis.violation`` records (rule + evidence fields) the online
+    sanitizer emitted during the run."""
+    return [
+        fields for name, fields in replay_jsonl(path) if name == "analysis.violation"
+    ]

@@ -1,0 +1,487 @@
+"""Typed metrics registry: counters, gauges, bounded-bucket histograms.
+
+The event layer (:mod:`uigc_tpu.utils.events`) answers "what happened in
+this process"; this module turns it into something exportable — a typed
+registry whose samples render to Prometheus text exposition
+(:mod:`uigc_tpu.telemetry.exporter`) or a JSON snapshot.  Population is
+two-sided, following Tascade's aggregation shape (PAPERS.md:
+hierarchical, asynchronous reduction of per-shard statistics rather
+than a central synchronous scrape):
+
+- an :class:`EventMetricsBridge` recorder listener folds the event
+  stream into the registry as events commit (GC wave latency, garbage
+  per wave, dead letters, undo folds, frame gaps/duplicates, …);
+- callback gauges sample live state lazily at export time (shadow-graph
+  size, mailbox depth, per-link phi) — nothing is polled until someone
+  actually scrapes.
+
+All metric mutation is thread-safe (one registry lock, never nested
+with any other lock).  Histograms use fixed bucket bounds, so memory is
+O(buckets) regardless of observation count — the same discipline as
+:class:`uigc_tpu.utils.events.DurationStat`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..utils import events
+from ..utils.validation import require
+
+#: Default histogram bucket bounds for durations (seconds) — shared
+#: geometry with the event recorder's duration stats.
+DURATION_BUCKETS = events.DURATION_BUCKET_BOUNDS_S
+
+#: Default bucket bounds for small non-negative counts (garbage per
+#: wave, entries per wake): powers of two up to 64k.
+COUNT_BUCKETS: Tuple[float, ...] = tuple(float(2**i) for i in range(17))
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class _Metric:
+    """Shared shape: name, help text, per-labelset storage."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str, lock: threading.Lock):
+        self.name = name
+        self.help_text = help_text
+        self._lock = lock
+
+    def samples(self) -> List[Tuple[str, LabelKey, float]]:
+        """Flat (suffix, labels, value) samples for the exporter."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonically increasing value, optionally per labelset."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help_text: str, lock: threading.Lock):
+        super().__init__(name, help_text, lock)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: Any) -> None:
+        require(
+            amount >= 0,
+            "metrics.counter_decrease",
+            "counters are monotone; inc() amount must be >= 0",
+            metric=self.name,
+            amount=amount,
+        )
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self) -> List[Tuple[str, LabelKey, float]]:
+        with self._lock:
+            items = list(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        return [("", key, value) for key, value in items]
+
+
+class Gauge(_Metric):
+    """Point-in-time value: set directly, or backed by a callback that
+    is sampled lazily at export time.  A callback may return a float or
+    a ``{labels_dict | label_str: value}`` mapping for per-label
+    fan-out."""
+
+    kind = "gauge"
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        lock: threading.Lock,
+        fn: Optional[Callable[[], Any]] = None,
+        label_name: str = "key",
+    ):
+        super().__init__(name, help_text, lock)
+        self._values: Dict[LabelKey, float] = {}
+        self._fn = fn
+        self._label_name = label_name
+
+    def set(self, value: float, **labels: Any) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self) -> List[Tuple[str, LabelKey, float]]:
+        if self._fn is not None:
+            try:
+                result = self._fn()
+            except Exception:  # a dead callback must not break the scrape
+                return []
+            if result is None:
+                return []
+            if isinstance(result, dict):
+                return [
+                    ("", _label_key({self._label_name: k}), float(v))
+                    for k, v in result.items()
+                ]
+            return [("", (), float(result))]
+        with self._lock:
+            items = list(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        return [("", key, value) for key, value in items]
+
+
+class Histogram(_Metric):
+    """Fixed-bound bucket histogram with streaming sum/count/min/max.
+
+    Each labelset is one :class:`uigc_tpu.utils.events.DurationStat` —
+    the single bounded-bucket implementation in the repo — and
+    :meth:`samples` renders the Prometheus cumulative
+    ``_bucket{le=...}`` series plus ``_sum`` and ``_count``."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        lock: threading.Lock,
+        buckets: Tuple[float, ...] = DURATION_BUCKETS,
+    ):
+        super().__init__(name, help_text, lock)
+        require(
+            len(buckets) > 0 and list(buckets) == sorted(buckets),
+            "metrics.bad_buckets",
+            "histogram bucket bounds must be a non-empty sorted sequence",
+            metric=name,
+        )
+        self.bounds: Tuple[float, ...] = tuple(float(b) for b in buckets)
+        self._data: Dict[LabelKey, events.DurationStat] = {}
+
+    def _slot(self, key: LabelKey) -> events.DurationStat:
+        stat = self._data.get(key)
+        if stat is None:
+            stat = self._data[key] = events.DurationStat(self.bounds)
+        return stat
+
+    def observe(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._slot(key).observe(float(value))
+
+    def snapshot(self, **labels: Any) -> Dict[str, Any]:
+        with self._lock:
+            stat = self._data.get(_label_key(labels))
+            if stat is None:
+                return {"counts": [0] * (len(self.bounds) + 1), "sum": 0.0, "n": 0}
+            return {
+                "counts": list(stat.buckets),
+                "sum": stat.total_s,
+                "n": stat.n,
+                "min": stat.min_s if stat.n else 0.0,
+                "max": stat.max_s,
+            }
+
+    def samples(self) -> List[Tuple[str, LabelKey, float]]:
+        with self._lock:
+            items = [
+                (k, list(s.buckets), s.total_s, s.n) for k, s in self._data.items()
+            ]
+        out: List[Tuple[str, LabelKey, float]] = []
+        for key, counts, total, n in items:
+            cumulative = 0
+            for bound, count in zip(self.bounds, counts):
+                cumulative += count
+                out.append(
+                    ("_bucket", key + (("le", _format_le(bound)),), float(cumulative))
+                )
+            out.append(("_bucket", key + (("le", "+Inf"),), float(n)))
+            out.append(("_sum", key, total))
+            out.append(("_count", key, float(n)))
+        return out
+
+
+def _format_le(bound: float) -> str:
+    """Stable, parse-friendly rendering of a bucket bound."""
+    return repr(bound)
+
+
+class MetricsRegistry:
+    """A named collection of metrics with optional constant labels
+    (e.g. ``node=<address>``) applied to every sample at export."""
+
+    def __init__(self, const_labels: Optional[Dict[str, Any]] = None):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self.const_labels = _label_key(const_labels or {})
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                require(
+                    type(existing) is type(metric),
+                    "metrics.kind_conflict",
+                    "metric re-registered with a different kind",
+                    metric=metric.name,
+                    existing=existing.kind,
+                    requested=metric.kind,
+                )
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(Counter(name, help_text, threading.Lock()))  # type: ignore[return-value]
+
+    def gauge(
+        self,
+        name: str,
+        help_text: str = "",
+        fn: Optional[Callable[[], Any]] = None,
+        label_name: str = "key",
+    ) -> Gauge:
+        return self._register(Gauge(name, help_text, threading.Lock(), fn, label_name))  # type: ignore[return-value]
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str = "",
+        buckets: Tuple[float, ...] = DURATION_BUCKETS,
+    ) -> Histogram:
+        return self._register(Histogram(name, help_text, threading.Lock(), buckets))  # type: ignore[return-value]
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def collect(self) -> Iterable[Tuple[_Metric, str, LabelKey, float]]:
+        """Yield every (metric, name_suffix, labels, value) sample, with
+        the registry's constant labels merged in."""
+        for metric in self.metrics():
+            for suffix, key, value in metric.samples():
+                yield metric, suffix, self.const_labels + key, value
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able dump: {metric_name: {kind, help, samples}}."""
+        out: Dict[str, Any] = {}
+        for metric, suffix, key, value in self.collect():
+            entry = out.setdefault(
+                metric.name,
+                {"kind": metric.kind, "help": metric.help_text, "samples": []},
+            )
+            entry["samples"].append(
+                {"suffix": suffix, "labels": dict(key), "value": value}
+            )
+        return out
+
+
+class EventMetricsBridge:
+    """Recorder listener folding the event stream into a registry.
+
+    One instance per attached system; registered via
+    ``events.recorder.add_listener`` and driven synchronously on the
+    committing thread, so the cost per event is a couple of dict lookups
+    and a histogram insert."""
+
+    def __init__(self, registry: MetricsRegistry, node: Optional[str] = None):
+        self.registry = registry
+        #: accept only events originating from this node's threads (the
+        #: recorder is process-global; without the scope, a multi-system
+        #: process would fold every peer's events into every registry).
+        #: Origin-less events (untagged user/test threads, shared
+        #: in-process fabric workers) are accepted by everyone.
+        self.node = node
+        r = registry
+        self._wave_seconds = r.histogram(
+            "uigc_gc_wave_seconds", "Latency of one collection (trace + sweep)."
+        )
+        self._wave_garbage = r.histogram(
+            "uigc_gc_garbage_actors",
+            "Garbage actors found per collection wave.",
+            buckets=COUNT_BUCKETS,
+        )
+        self._garbage_total = r.counter(
+            "uigc_gc_garbage_total", "Total garbage actors collected."
+        )
+        self._live_actors = r.gauge(
+            "uigc_gc_live_actors", "Live actors at the last collection wave."
+        )
+        self._entries_total = r.counter(
+            "uigc_entries_flushed_total", "Mutator entries flushed to the collector."
+        )
+        self._ingest_seconds = r.histogram(
+            "uigc_gc_ingest_seconds", "Latency of one entry-queue drain + fold."
+        )
+        self._device_seconds = r.histogram(
+            "uigc_device_trace_seconds", "Device time of one trace kernel dispatch."
+        )
+        self._dead_letters = r.counter(
+            "uigc_dead_letters_total", "Messages routed through dead-letter accounting."
+        )
+        self._undo_folds = r.counter(
+            "uigc_undo_folds_total", "Dead-node undo logs folded into the shadow graph."
+        )
+        self._frame_gaps = r.counter(
+            "uigc_frame_gaps_total", "Frames the sequence layer observed as missing."
+        )
+        self._frame_dups = r.counter(
+            "uigc_frame_duplicates_total", "Duplicate frames discarded by the sequence layer."
+        )
+        self._frames_dropped = r.counter(
+            "uigc_frames_dropped_total", "Frames dropped (fault injection or admission)."
+        )
+        self._frames_corrupt = r.counter(
+            "uigc_frames_corrupt_total", "Frames whose body failed to decode."
+        )
+        self._node_down = r.counter(
+            "uigc_node_down_total", "Peer-death verdicts, by reason."
+        )
+        self._node_suspect = r.counter(
+            "uigc_node_suspect_total", "Early-warning phi threshold crossings."
+        )
+        self._reconnects = r.counter(
+            "uigc_link_reconnects_total", "Torn links healed by reconnect."
+        )
+        self._listener_errors = r.counter(
+            "uigc_listener_errors_total", "Recorder listeners that raised during dispatch."
+        )
+        self._merge_delta_seconds = r.histogram(
+            "uigc_merge_delta_seconds", "Latency of folding one peer delta graph."
+        )
+        self._merge_ingress_seconds = r.histogram(
+            "uigc_merge_ingress_seconds", "Latency of folding one ingress entry."
+        )
+
+    def __call__(self, name: str, fields: Dict[str, Any]) -> None:
+        if self.node is not None:
+            origin = fields.get("origin")
+            if origin is not None and origin != self.node:
+                return
+        duration = fields.get("duration_s")
+        if name == events.TRACING:
+            if duration is not None:
+                self._wave_seconds.observe(duration)
+            garbage = fields.get("num_garbage_actors")
+            if garbage is not None:
+                self._wave_garbage.observe(garbage)
+                if garbage:
+                    self._garbage_total.inc(garbage)
+            live = fields.get("num_live_actors")
+            if live is not None:
+                self._live_actors.set(live)
+        elif name == events.ENTRY_SEND:
+            self._entries_total.inc()
+        elif name == events.PROCESSING_ENTRIES:
+            if duration is not None:
+                self._ingest_seconds.observe(duration)
+        elif name == events.DEVICE_TRACE:
+            if duration is not None:
+                self._device_seconds.observe(duration)
+        elif name == events.DEAD_LETTER:
+            self._dead_letters.inc()
+        elif name == events.UNDO_FOLD:
+            self._undo_folds.inc(address=fields.get("address", ""))
+        elif name == events.FRAME_GAP:
+            self._frame_gaps.inc(fields.get("missed", 1), src=fields.get("src", ""))
+        elif name == events.FRAME_DUPLICATE:
+            self._frame_dups.inc(src=fields.get("src", ""))
+        elif name == events.FRAME_DROPPED:
+            self._frames_dropped.inc()
+        elif name == events.FRAME_CORRUPT:
+            self._frames_corrupt.inc()
+        elif name == events.NODE_DOWN:
+            self._node_down.inc(reason=fields.get("reason", "?"))
+        elif name == events.NODE_SUSPECT:
+            self._node_suspect.inc()
+        elif name == events.LINK_RECONNECT:
+            self._reconnects.inc()
+        elif name == events.LISTENER_ERROR:
+            self._listener_errors.inc()
+        elif name == events.MERGING_DELTA_GRAPHS:
+            if duration is not None:
+                self._merge_delta_seconds.observe(duration)
+        elif name == events.MERGING_INGRESS_ENTRIES:
+            if duration is not None:
+                self._merge_ingress_seconds.observe(duration)
+
+
+def _shadow_graph_size(system: Any) -> Optional[int]:
+    """Duck-typed shadow population across backends: array (slot_of),
+    oracle (shadow_map), native (_id_of_cell)."""
+    engine = getattr(system, "engine", None)
+    bookkeeper = getattr(engine, "bookkeeper", None)
+    graph = getattr(bookkeeper, "shadow_graph", None)
+    if graph is None:
+        return None
+    for attr in ("slot_of", "shadow_map", "_id_of_cell"):
+        table = getattr(graph, attr, None)
+        if table is not None:
+            return len(table)
+    return None
+
+
+def _mailbox_depth(system: Any) -> int:
+    with system._cells_lock:
+        cells = list(system._cells.values())
+    return sum(len(cell._mailbox) for cell in cells)
+
+
+def install_system_gauges(registry: MetricsRegistry, system: Any) -> None:
+    """The direct taps: live state sampled lazily at export time."""
+    registry.gauge(
+        "uigc_shadow_graph_size",
+        "Shadows held by the collector's graph.",
+        fn=lambda: _shadow_graph_size(system),
+    )
+    registry.gauge(
+        "uigc_mailbox_depth",
+        "Application messages pending across all live mailboxes.",
+        fn=lambda: _mailbox_depth(system),
+    )
+    registry.gauge(
+        "uigc_live_actors",
+        "Cells currently registered with the system.",
+        fn=lambda: system.live_actor_count,
+    )
+    registry.gauge(
+        "uigc_dead_letters",
+        "Cumulative dead-letter count (system tally).",
+        fn=lambda: system.dead_letters,
+    )
+    registry.gauge(
+        "uigc_link_phi",
+        "Phi-accrual suspicion per peer link (NodeFabric heartbeat).",
+        fn=lambda: _link_phis(system),
+        label_name="peer",
+    )
+    registry.gauge(
+        "uigc_fabric_transit_depth",
+        "Messages in transit on the fabric's async queue.",
+        fn=lambda: _transit_depth(system),
+    )
+
+
+def _link_phis(system: Any) -> Optional[Dict[str, float]]:
+    fabric = getattr(system, "fabric", None)
+    monitor = getattr(fabric, "_hb", None)
+    if monitor is None:
+        return None
+    return monitor.phis()
+
+
+def _transit_depth(system: Any) -> Optional[int]:
+    fabric = getattr(system, "fabric", None)
+    depth = getattr(fabric, "queue_depth", None)
+    return depth() if callable(depth) else None

@@ -1,0 +1,195 @@
+"""Collector wake profiler: per-phase, device-vs-host wake attribution.
+
+One Bookkeeper wake (``engines/crgc/collector.py collect()``) is the
+unit of collection latency, but a single wall-clock number cannot say
+*where* a slow wake went.  This profiler breaks every wake into the
+pipeline's named phases:
+
+- ``ingest``     draining the mutator entry queue + packed rows
+- ``fold``       merging the drained batch into the shadow graph
+- ``trace``      the liveness trace (mark computation; includes the
+                 device kernel dispatch on device backends)
+- ``sweep``      kill decisions + slot frees (attributed from the
+                 ``crgc.sweep`` event every backend emits, and
+                 subtracted from the enclosing trace bracket)
+- ``broadcast``  delta-graph serialization + peer broadcast (multi-node)
+
+Device time is attributed by hooking the ``tpu.device_trace`` event:
+the profiler registers as a recorder listener and credits device
+durations committed on the wake's thread to the active wake, so every
+phase report carries both host wall time and the device share.
+
+Dumps are BENCH-style JSON (one ``wake_profile`` document per node),
+matching the ``tools/*_bench.py`` artifact convention.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..utils import events
+
+PHASES = ("ingest", "fold", "trace", "sweep", "broadcast")
+
+
+class _PhaseFrame:
+    __slots__ = ("name", "acc", "last_start")
+
+    def __init__(self, name: str, now: float):
+        self.name = name
+        self.acc = 0.0
+        self.last_start = now
+
+
+class _Phase:
+    """Context manager charging exclusive time to one named phase; a
+    nested phase pauses the enclosing one (so ``broadcast`` inside the
+    ingest drain loop is never double-counted)."""
+
+    __slots__ = ("wake", "name")
+
+    def __init__(self, wake: "_Wake", name: str):
+        self.wake = wake
+        self.name = name
+
+    def __enter__(self) -> "_Phase":
+        now = time.perf_counter()
+        stack = self.wake.stack
+        if stack:
+            top = stack[-1]
+            top.acc += now - top.last_start
+        stack.append(_PhaseFrame(self.name, now))
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        now = time.perf_counter()
+        stack = self.wake.stack
+        frame = stack.pop()
+        frame.acc += now - frame.last_start
+        self.wake.phases[frame.name] = (
+            self.wake.phases.get(frame.name, 0.0) + frame.acc
+        )
+        if stack:
+            stack[-1].last_start = now
+
+
+class _Wake:
+    """Accounting for one in-flight collector wake."""
+
+    __slots__ = ("profiler", "thread", "t0", "start", "phases", "stack",
+                 "device_s", "sweep_s")
+
+    def __init__(self, profiler: "WakeProfiler"):
+        self.profiler = profiler
+        self.thread = threading.get_ident()
+        self.t0 = time.time()
+        self.start = time.perf_counter()
+        self.phases: Dict[str, float] = {}
+        self.stack: List[_PhaseFrame] = []
+        self.device_s = 0.0
+        self.sweep_s = 0.0
+
+    def phase(self, name: str) -> _Phase:
+        return _Phase(self, name)
+
+    def end(self, **fields: Any) -> None:
+        self.profiler._finish(self, time.perf_counter() - self.start, fields)
+
+
+class WakeProfiler:
+    """Per-system wake profiler.  Install as the engine's
+    ``wake_profiler`` (the collector consults it each wake) and as a
+    recorder listener (device/sweep attribution); both are done by
+    :meth:`uigc_tpu.telemetry.Telemetry.attach`."""
+
+    def __init__(self, node: str, max_recent: int = 256):
+        self.node = node
+        self._lock = threading.Lock()
+        self._active: Optional[_Wake] = None
+        self._wakes = 0
+        self._wall_total = 0.0
+        self._wall_max = 0.0
+        self._totals: Dict[str, Dict[str, float]] = {
+            name: {"total_s": 0.0, "max_s": 0.0, "device_total_s": 0.0}
+            for name in PHASES
+        }
+        self._recent: deque = deque(maxlen=max_recent)
+        self._entries_total = 0
+        self._garbage_total = 0
+
+    # -- wake lifecycle (called from the Bookkeeper thread) ---------- #
+
+    def begin_wake(self) -> _Wake:
+        wake = _Wake(self)
+        self._active = wake
+        return wake
+
+    def _finish(self, wake: _Wake, wall_s: float, fields: Dict[str, Any]) -> None:
+        self._active = None
+        phases = {name: wake.phases.get(name, 0.0) for name in PHASES}
+        # The sweep ran inside the trace bracket: report it as its own
+        # phase and keep trace exclusive.
+        phases["sweep"] += wake.sweep_s
+        phases["trace"] = max(0.0, phases["trace"] - wake.sweep_s)
+        record = {
+            "t": wake.t0,
+            "wall_s": wall_s,
+            "device_s": wake.device_s,
+            "phases": phases,
+            **fields,
+        }
+        with self._lock:
+            self._wakes += 1
+            self._wall_total += wall_s
+            if wall_s > self._wall_max:
+                self._wall_max = wall_s
+            self._entries_total += int(fields.get("entries", 0) or 0)
+            self._garbage_total += int(fields.get("garbage", 0) or 0)
+            for name in PHASES:
+                totals = self._totals[name]
+                totals["total_s"] += phases[name]
+                if phases[name] > totals["max_s"]:
+                    totals["max_s"] = phases[name]
+            self._totals["trace"]["device_total_s"] += wake.device_s
+            self._recent.append(record)
+
+    # -- recorder listener (device / sweep attribution) -------------- #
+
+    def __call__(self, name: str, fields: Dict[str, Any]) -> None:
+        if name != events.DEVICE_TRACE and name != events.SWEEP:
+            return
+        wake = self._active
+        if wake is None or wake.thread != threading.get_ident():
+            return
+        duration = fields.get("duration_s") or 0.0
+        if name == events.DEVICE_TRACE:
+            wake.device_s += duration
+        else:
+            wake.sweep_s += duration
+
+    # -- export ------------------------------------------------------ #
+
+    def to_json(self) -> Dict[str, Any]:
+        """BENCH-style document: per-phase totals plus the recent wakes."""
+        with self._lock:
+            return {
+                "bench": "wake_profile",
+                "node": self.node,
+                "wakes": self._wakes,
+                "wall_total_s": self._wall_total,
+                "wall_max_s": self._wall_max,
+                "entries_total": self._entries_total,
+                "garbage_total": self._garbage_total,
+                "phases": {k: dict(v) for k, v in self._totals.items()},
+                "recent": list(self._recent),
+            }
+
+    def dump(self, path: str) -> Dict[str, Any]:
+        doc = self.to_json()
+        with open(path, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+        return doc

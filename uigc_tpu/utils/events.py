@@ -12,10 +12,13 @@ flush events; enable with :func:`enable` or per-category.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
+import traceback
+from bisect import bisect_left
 from collections import defaultdict
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # Event names, mirroring the reference's JFR classes:
 #   crgc/jfr/EntrySendEvent, EntryFlushEvent, ProcessingEntries,
@@ -33,6 +36,11 @@ INGRESS_ENTRY_SERIALIZATION = "crgc.ingress_entry_serialization"
 ACTOR_BLOCKED = "mac.actor_blocked"
 PROCESSING_MESSAGES = "mac.processing_messages"
 DEVICE_TRACE = "tpu.device_trace"  # ours: one device kernel dispatch
+#: The sweep half of one collection (kill decisions + slot/shadow frees),
+#: nested inside ``crgc.tracing``.  Emitted by every shadow-graph backend
+#: so the wake profiler (uigc_tpu/telemetry/profile.py) can attribute
+#: trace-vs-sweep time without backend-specific hooks.
+SWEEP = "crgc.sweep"
 
 # Transport/failure events (ours; the reference has no failure-injection
 # instrumentation).  Emitted by runtime/node.py, runtime/fabric.py,
@@ -92,6 +100,86 @@ FRAME_GAP = "fabric.frame_gap"
 FRAME_CORRUPT = "fabric.frame_corrupt"
 UNDO_FOLD = "crgc.undo_fold"
 
+# Telemetry self-observation (uigc_tpu/telemetry):
+#   telemetry.listener_error  a recorder listener raised during dispatch;
+#                             fields: listener, event, error.  Counted so
+#                             broken listeners are a metric, not just a
+#                             traceback scrolling past on stderr.
+LISTENER_ERROR = "telemetry.listener_error"
+
+#: Per-thread event origin (a node address).  The recorder is a process
+#: singleton; when several ActorSystems share one process (the
+#: in-process multi-node topologies), a per-node consumer — the
+#: telemetry metrics bridge, an offline log splitter — needs to know
+#: WHICH system produced an event.  Each system tags the threads it
+#: owns (dispatcher workers, pinned collector threads, the timer
+#: service, node-transport loops) with its address; ``commit`` stamps
+#: the tag into every listener payload as ``origin``.  Threads nobody
+#: tagged (user/test threads) stay origin-less, which consumers treat
+#: as "unscoped: accept".
+_ORIGIN_TLS = threading.local()
+
+
+def set_thread_origin(origin: Optional[str]) -> None:
+    """Tag the calling thread's committed events with ``origin``."""
+    _ORIGIN_TLS.origin = origin
+
+
+def thread_origin() -> Optional[str]:
+    return getattr(_ORIGIN_TLS, "origin", None)
+
+#: Fixed duration-histogram bucket upper bounds (seconds): powers of two
+#: from 1µs to ~134s, plus an implicit overflow bucket.  Shared with the
+#: telemetry metrics registry so recorder snapshots and Prometheus
+#: exposition agree on bucket geometry.
+DURATION_BUCKET_BOUNDS_S: Tuple[float, ...] = tuple(
+    1e-6 * (2.0**i) for i in range(28)
+)
+
+
+class DurationStat:
+    """Streaming summary of one observed quantity: count/total/min/max
+    plus a fixed-size histogram over ``bounds`` (default: the duration
+    bucket geometry above).  The one bounded-bucket implementation —
+    the telemetry metrics registry reuses it per labelset.
+
+    Replaces the old unbounded per-event duration list: memory is
+    O(buckets) no matter how many events are observed (a 1M-event loop
+    holds the same ~30 counters as a 10-event one)."""
+
+    __slots__ = ("n", "total_s", "max_s", "min_s", "bounds", "buckets")
+
+    def __init__(self, bounds: Tuple[float, ...] = DURATION_BUCKET_BOUNDS_S) -> None:
+        self.n = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.min_s = float("inf")
+        self.bounds = bounds
+        #: non-cumulative counts; index i counts observations x with
+        #: bounds[i-1] < x <= bounds[i]; the last slot is the overflow.
+        self.buckets = [0] * (len(bounds) + 1)
+
+    def observe(self, duration_s: float) -> None:
+        self.n += 1
+        self.total_s += duration_s
+        if duration_s > self.max_s:
+            self.max_s = duration_s
+        if duration_s < self.min_s:
+            self.min_s = duration_s
+        self.buckets[bisect_left(self.bounds, duration_s)] += 1
+
+    def summary(self) -> Dict[str, Any]:
+        """Snapshot dict; keeps the historical ``n``/``total_s``/``max_s``
+        shape and adds the streaming extras."""
+        return {
+            "n": self.n,
+            "total_s": self.total_s,
+            "max_s": self.max_s,
+            "min_s": self.min_s if self.n else 0.0,
+            "mean_s": (self.total_s / self.n) if self.n else 0.0,
+            "buckets": list(self.buckets),
+        }
+
 
 class EventRecorder:
     """Thread-safe counter/duration sink with optional listeners.
@@ -110,8 +198,9 @@ class EventRecorder:
         self._seq = 0
         self._counts: Dict[str, int] = defaultdict(int)
         self._sums: Dict[str, float] = defaultdict(float)
-        self._durations: Dict[str, List[float]] = defaultdict(list)
+        self._durations: Dict[str, DurationStat] = defaultdict(DurationStat)
         self._listeners: List[Callable[[str, Dict[str, Any]], None]] = []
+        self._tls = threading.local()  # listener-error reentrancy guard
 
     def enable(self) -> None:
         self.enabled = True
@@ -128,9 +217,17 @@ class EventRecorder:
             if fn in self._listeners:
                 self._listeners.remove(fn)
 
+    def suppressed(self) -> "_Suppressed":
+        """Context manager muting this thread's commits.  For tooling
+        that re-runs instrumented pipeline code as a shadow computation
+        (the sanitizer's oracle trace): without it, the mirror emits the
+        same ``crgc.tracing``/``crgc.sweep`` events as the real backend
+        and every metrics consumer double-counts the wave."""
+        return _Suppressed(self)
+
     def commit(self, name: str, duration_s: Optional[float] = None, **fields: Any) -> None:
         """Record one event occurrence (the JFR ``commit()`` analogue)."""
-        if not self.enabled:
+        if not self.enabled or getattr(self._tls, "suppress", False):
             return
         with self._lock:
             self._counts[name] += 1
@@ -138,20 +235,43 @@ class EventRecorder:
                 if isinstance(value, (int, float)) and not isinstance(value, bool):
                     self._sums[f"{name}.{key}"] += value
             if duration_s is not None:
-                self._durations[name].append(duration_s)
+                self._durations[name].observe(duration_s)
             seq = self._seq
             self._seq = seq + 1
             listeners = list(self._listeners)
         if not listeners:
             return
         payload = dict(fields, duration_s=duration_s, seq=seq)
+        origin = getattr(_ORIGIN_TLS, "origin", None)
+        if origin is not None:
+            payload.setdefault("origin", origin)
         for fn in listeners:
             try:
                 fn(name, dict(payload))
-            except Exception:  # one bad listener must not break the rest
-                import traceback
+            except Exception as exc:  # one bad listener must not break the rest
+                self._on_listener_error(fn, name, exc)
 
-                traceback.print_exc()
+    def _on_listener_error(self, fn: Any, name: str, exc: Exception) -> None:
+        """A listener raised: log the traceback to stderr AND commit a
+        structured ``telemetry.listener_error`` event, so broken listeners
+        are countable (snapshot counts, metrics, JSONL) rather than only
+        printed.  Reentrancy-guarded: a listener that also throws on the
+        error event is counted silently instead of recursing."""
+        traceback.print_exc(file=sys.stderr)
+        if getattr(self._tls, "in_error", False):
+            with self._lock:
+                self._counts[LISTENER_ERROR] += 1
+            return
+        self._tls.in_error = True
+        try:
+            self.commit(
+                LISTENER_ERROR,
+                listener=repr(fn),
+                event=name,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+        finally:
+            self._tls.in_error = False
 
     def timed(self, name: str) -> "_Timed":
         return _Timed(self, name)
@@ -160,8 +280,7 @@ class EventRecorder:
         with self._lock:
             out: Dict[str, Any] = {"counts": dict(self._counts), "sums": dict(self._sums)}
             out["durations"] = {
-                k: {"n": len(v), "total_s": sum(v), "max_s": max(v) if v else 0.0}
-                for k, v in self._durations.items()
+                k: stat.summary() for k, stat in self._durations.items()
             }
             return out
 
@@ -170,6 +289,26 @@ class EventRecorder:
             self._counts.clear()
             self._sums.clear()
             self._durations.clear()
+
+
+class _Suppressed:
+    """Per-thread commit mute (see :meth:`EventRecorder.suppressed`).
+    Nestable: restores the previous state on exit."""
+
+    __slots__ = ("_recorder", "_prev")
+
+    def __init__(self, recorder: EventRecorder):
+        self._recorder = recorder
+        self._prev = False
+
+    def __enter__(self) -> "_Suppressed":
+        tls = self._recorder._tls
+        self._prev = getattr(tls, "suppress", False)
+        tls.suppress = True
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self._recorder._tls.suppress = self._prev
 
 
 class _Timed:
