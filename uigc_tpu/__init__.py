@@ -12,6 +12,7 @@ Public API mirrors the reference's ``edu.illinois.osl.uigc`` surface:
 config key.
 """
 
+from .cluster import ClusterSharding, Entity, EntityRef
 from .config import Config
 from .interfaces import GCMessage, Message, NoRefs, Refob, SpawnInfo, State
 from .runtime.behaviors import AbstractBehavior, ActorFactory, Behaviors, RawBehavior
@@ -34,7 +35,10 @@ __all__ = [
     "ActorSystem",
     "ActorTestKit",
     "Behaviors",
+    "ClusterSharding",
     "Config",
+    "Entity",
+    "EntityRef",
     "GCMessage",
     "Message",
     "NoRefs",

@@ -136,6 +136,23 @@ class _Tap(EngineTap):
         with san._lock:
             san.releases[target] = san.releases.get(target, 0) + 1
 
+    def on_migrate_out(self, cell: Any, key: str) -> None:
+        # A live migration moves the entity's remaining balance to
+        # another node's books: local send/recv comparison for this
+        # cell is meaningless from here on (same verdict as a message
+        # that crossed a node boundary).
+        san = self.san
+        with san._lock:
+            san.tainted.add(cell)
+
+    def on_migrate_in(self, cell: Any, key: str) -> None:
+        # The reconstructed incarnation's history (creates/sends under
+        # the old uid) lives on the source node; never compare local
+        # ground truth against it.
+        san = self.san
+        with san._lock:
+            san.tainted.add(cell)
+
     def on_stop_decision(self, cell: Any, msg: Any) -> None:
         san = self.san
         if san.oracle is None:

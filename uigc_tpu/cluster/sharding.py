@@ -1,0 +1,1117 @@
+"""Cluster sharding: GC-aware entity placement over the node fabric.
+
+The missing subsystem between "actor GC middleware" (PAPER.md's UIGC
+capability) and a serving fabric: named entities are placed by key,
+survive membership change by live migration (migration.py), and idle
+entities passivate to an in-memory store (passivation.py) — a controlled
+quiescence decision, which is exactly the judgment the GC engines
+already make for unreferenced actors.
+
+Design, in the spirit of Akka Cluster Sharding but coordinator-free:
+
+- **Placement** is a pure function of the member set: entity key ->
+  shard (stable hash) -> node (rendezvous hash over members).  Every
+  node computes the same table from the same membership view, so there
+  is no shard coordinator to block on; versioned tables are gossiped
+  over the existing ``NodeFabric`` frames (new ``"shard"`` kind,
+  version-tolerant like the PR 3 trace header) purely to reconcile
+  *transient* view differences — the Tascade-shaped choice (PAPERS.md:
+  asynchronous dissemination, no synchronous coordinator).
+- **Routing** goes through :class:`EntityRef`, a location-transparent
+  handle (Palgol's remote-data-access model, PAPERS.md): it names a
+  ``(type, key)`` coordinate, never a cell.  The local shard region
+  resolves the current home, spawns entities on demand, buffers during
+  handoff, and forwards stragglers instead of dead-lettering them.
+- **GC-awareness**: entities are spawned as *root* actors (pseudoroots
+  — explicitly managed by the region, exactly like the reference's
+  root actors), so the engines never collect a placed entity out from
+  under the sharding layer; passivation and migration stop entities
+  through the normal termination protocol, whose death accounting
+  (CRGC ``pre_signal``) keeps every balance sound.  Migrated snapshots
+  have their refs re-registered through the destination engine
+  (migration.translate_refs) and announced via the ``EngineTap``
+  migration hooks so the sanitizer's oracle agrees.
+
+Entity messages are *external* traffic at both ends (the root-adapter
+wrap), like requests entering the cluster from outside: refs they carry
+re-materialize as unmanaged root references on the receiving node and do
+not, by themselves, keep their targets alive — the same contract as
+``RawRef`` sends.  Refs inside a migrated snapshot, by contrast, ARE
+re-registered with the destination engine and do keep targets alive.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import itertools
+import re
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
+
+from ..runtime.behaviors import AbstractBehavior, ActorFactory, RawBehavior
+from ..runtime.fabric import MemberRemoved, MemberUp
+from ..runtime import wire
+from ..utils import events
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..runtime.cell import ActorCell
+    from ..runtime.system import ActorSystem
+
+# Entity record statuses.
+_ACTIVE = "active"
+_HANDOFF = "handoff"
+_PASSIVATING = "passivating"
+
+#: sentinel distinguishing "shard not held" from "held awaiting any grant"
+_NOT_HELD = object()
+
+
+class _GrantWatch:
+    """One lost shard's outstanding handoffs.  ``scanned`` stays False
+    between the table transition that created the watch and the handoff
+    scan that registers its keys — an empty-but-unscanned watch must
+    never be granted (the keys just haven't been enumerated yet)."""
+
+    __slots__ = ("owner", "keys", "scanned")
+
+    def __init__(self, owner: str):
+        self.owner = owner
+        self.keys: set = set()
+        self.scanned = False
+
+
+# ------------------------------------------------------------------- #
+# Placement: key -> shard -> node
+# ------------------------------------------------------------------- #
+
+
+@functools.lru_cache(maxsize=1 << 16)
+def _stable_hash(text: str) -> int:
+    """64-bit mixing hash, stable across processes (the builtin hash is
+    salted; CRC is too linear for rendezvous scoring — one member's
+    suffix dominates every shard).  Memoized: routing hashes the same
+    entity keys over and over."""
+    return int.from_bytes(
+        hashlib.blake2b(text.encode(), digest_size=8).digest(), "big"
+    )
+
+
+def shard_of(key: str, num_shards: int) -> int:
+    """Stable key -> shard mapping; every node and every process must
+    agree."""
+    return _stable_hash(key) % num_shards
+
+
+def rendezvous_assign(members: List[str], num_shards: int) -> Dict[int, str]:
+    """Highest-random-weight assignment of shards to members: each
+    shard lands on the member with the max hash(shard|member).  Pure and
+    deterministic in the member set; removing one member moves only that
+    member's shards (minimal churn), which is what keeps a rebalance
+    from migrating the whole keyspace."""
+    if not members:
+        return {}
+    out: Dict[int, str] = {}
+    for shard in range(num_shards):
+        out[shard] = max(members, key=lambda m: _stable_hash(f"{shard}|{m}"))
+    return out
+
+
+class ShardTable:
+    """A versioned shard->address assignment.  Versions totally order
+    table adoptions across the cluster: (version, origin) is a lamport
+    pair, so two nodes that recompute concurrently converge on one
+    winner even before their membership views agree."""
+
+    __slots__ = ("version", "origin", "assignments")
+
+    def __init__(self, version: int, origin: str, assignments: Dict[int, str]):
+        self.version = version
+        self.origin = origin
+        self.assignments = assignments
+
+    def owner(self, shard: int) -> Optional[str]:
+        return self.assignments.get(shard)
+
+    def supersedes(self, other: "ShardTable") -> bool:
+        if self.version != other.version:
+            return self.version > other.version
+        if self.assignments == other.assignments:
+            return False
+        return self.origin < other.origin  # deterministic tiebreak
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"ShardTable(v{self.version}@{self.origin}, {len(self.assignments)} shards)"
+
+
+# ------------------------------------------------------------------- #
+# Entity user API
+# ------------------------------------------------------------------- #
+
+
+class _EntityCtl:
+    """Base for sharding-internal control payloads delivered to entity
+    cells (handoff capture, passivation capture).  Polymorphic apply()
+    keeps :class:`Entity` free of imports from migration/passivation."""
+
+    __slots__ = ()
+
+    def apply(self, entity: "Entity") -> Any:
+        raise NotImplementedError
+
+
+class Entity(AbstractBehavior):
+    """Base class for sharded entity behaviors.
+
+    Subclasses implement :meth:`receive` (instead of ``on_message``,
+    which the sharding layer reserves for its control protocol) and —
+    if they want passivation/migration to preserve state —
+    :meth:`snapshot_state`, returning a picklable value.  Refobs inside
+    the snapshot (at any container depth) are re-registered through the
+    destination engine on restore.
+    """
+
+    def __init__(self, context: Any, key: str):
+        super().__init__(context)
+        self.key = key
+
+    # -- user surface ------------------------------------------------ #
+
+    def receive(self, msg: Any) -> Any:
+        raise NotImplementedError
+
+    def snapshot_state(self) -> Any:
+        """State to carry across passivation/migration; None means the
+        entity restarts fresh."""
+        return None
+
+    # -- runtime surface --------------------------------------------- #
+
+    def on_message(self, msg: Any) -> Any:
+        if isinstance(msg, _EntityCtl):
+            return msg.apply(self)
+        return self.receive(msg)
+
+
+#: factory signature: (ctx, key, restored_state_or_None) -> Entity
+EntityFactory = Callable[[Any, str, Any], Entity]
+
+
+class EntityRef:
+    """Location-transparent handle for a sharded entity.
+
+    Routes ``tell`` through the local shard region: the region resolves
+    the key's current home node, spawns the entity on demand, buffers
+    during handoff, and forwards after migration — the caller never
+    sees placement.  Crossing a node boundary inside a message, an
+    EntityRef re-encodes as its ``(type, key)`` coordinates (wire.py)
+    and re-binds to the destination's region.
+    """
+
+    __slots__ = ("_cluster", "type_name", "key")
+
+    def __init__(self, cluster: "ClusterSharding", type_name: str, key: str):
+        self._cluster = cluster
+        self.type_name = type_name
+        self.key = key
+
+    def tell(self, msg: Any) -> None:
+        self._cluster.route(self.type_name, self.key, msg)
+
+    def __eq__(self, other: Any) -> bool:
+        return (
+            isinstance(other, EntityRef)
+            and other.type_name == self.type_name
+            and other.key == self.key
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.type_name, self.key))
+
+    def __repr__(self) -> str:
+        return f"EntityRef({self.type_name}/{self.key})"
+
+
+# ------------------------------------------------------------------- #
+# Shard region: the per-type, per-node entity host
+# ------------------------------------------------------------------- #
+
+
+class _EntityRecord:
+    __slots__ = ("cell", "status")
+
+    def __init__(self, cell: "ActorCell", status: str = _ACTIVE):
+        self.cell = cell
+        self.status = status
+
+
+class ShardRegion:
+    """Hosts the local entities of one entity type.  All mutable state
+    is guarded by one re-entrant lock; delivery inside the lock keeps
+    mailbox order consistent with handoff marking (a message routed
+    after a key enters handoff is ALWAYS buffered, never enqueued
+    behind the capture command)."""
+
+    def __init__(
+        self,
+        cluster: "ClusterSharding",
+        type_name: str,
+        factory: EntityFactory,
+        passivate_after_s: Optional[float] = None,
+    ):
+        from .passivation import PassivationPolicy, StateStore
+
+        self.cluster = cluster
+        self.type_name = type_name
+        self.factory = factory
+        self._lock = threading.RLock()
+        self._entities: Dict[str, _EntityRecord] = {}
+        #: messages parked while their key is mid-handoff/passivation
+        self._buffers: Dict[str, List[Any]] = {}
+        self.store = StateStore()
+        self.passivation = PassivationPolicy(
+            passivate_after_s
+            if passivate_after_s is not None
+            else cluster.passivate_after_s
+        )
+
+    # -- user surface ------------------------------------------------ #
+
+    def entity_ref(self, key: str) -> EntityRef:
+        return EntityRef(self.cluster, self.type_name, key)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return sum(
+                1 for r in self._entities.values() if r.status == _ACTIVE
+            )
+
+    def passive_count(self) -> int:
+        return self.store.size()
+
+    def buffered_depth(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._buffers.values())
+
+    def active_keys(self) -> List[str]:
+        with self._lock:
+            return [k for k, r in self._entities.items() if r.status == _ACTIVE]
+
+    def record_keys(self) -> List[str]:
+        """Every key with a record, INCLUDING those mid-transition —
+        the rebalance scan must count a passivating key as outstanding
+        or its about-to-spill snapshot strands behind an early grant."""
+        with self._lock:
+            return list(self._entities)
+
+    # -- delivery ---------------------------------------------------- #
+
+    def deliver_local(self, key: str, payload: Any) -> None:
+        """Deliver to the local entity for ``key``, activating it (from
+        the passivation store or fresh) when absent."""
+        with self._lock:
+            rec = self._entities.get(key)
+            if rec is not None and rec.status != _ACTIVE:
+                buf = self._buffers.setdefault(key, [])
+                buf.append(payload)
+                if events.recorder.enabled:
+                    events.recorder.commit(
+                        events.SHARD_HANDOFF_BUFFERED,
+                        key=key,
+                        type=self.type_name,
+                        depth=len(buf),
+                    )
+                return
+            if rec is None:
+                snapshot = self.store.pop(key)
+                cell = self._spawn(key, snapshot, resumed=snapshot is not None)
+                rec = self._entities[key] = _EntityRecord(cell)
+            rec.cell.tell(payload)
+
+    def _spawn(
+        self,
+        key: str,
+        snapshot: Any,
+        resumed: bool = False,
+        migrated: bool = False,
+    ) -> "ActorCell":
+        """Construct the entity cell as a root actor (a pseudoroot: the
+        region, not the GC, decides when it dies).  Caller holds the
+        region lock."""
+        from .migration import translate_refs
+
+        cluster = self.cluster
+        system = cluster.system
+        factory_fn = self.factory
+        type_name = self.type_name
+
+        def setup(ctx: Any) -> Entity:
+            state = snapshot
+            if migrated and state is not None:
+                # Re-register carried refs through the DESTINATION
+                # engine: the shadow graph gains (entity -> target)
+                # edges, so targets kept alive by migrated state stay
+                # provably reachable.
+                state = translate_refs(state, ctx)
+            behavior = factory_fn(ctx, key, state)
+            if not isinstance(behavior, Entity):
+                raise TypeError(
+                    f"entity factory for {type_name!r} must return an "
+                    f"Entity subclass, got {type(behavior).__name__}"
+                )
+            return behavior
+
+        name = f"sh-{type_name}-{_safe_name(key)}-{next(cluster._name_seq)}"
+        cell = system.spawn_cell(
+            ActorFactory(setup, is_root=True),
+            name,
+            system._user_guardian,
+            system.engine.root_spawn_info(),
+        )
+        if migrated:
+            tap = system.engine.tap
+            if tap is not None:
+                try:
+                    tap.on_migrate_in(cell, key)
+                except Exception:  # taps observe, never alter control flow
+                    import traceback
+
+                    traceback.print_exc()
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SHARD_ENTITY_ACTIVATED,
+                key=key,
+                type=type_name,
+                resumed=resumed,
+                migrated=migrated,
+            )
+        return cell
+
+    # -- transition plumbing (migration.py / passivation.py) --------- #
+
+    def _begin_transition(self, key: str, status: str, cmd: _EntityCtl) -> bool:
+        """Flip an ACTIVE entity into a buffering transition state and
+        enqueue its capture command.  The lock is held across the tell,
+        so no region-routed message can slip in behind the command."""
+        with self._lock:
+            rec = self._entities.get(key)
+            if rec is None or rec.status != _ACTIVE:
+                return False
+            rec.status = status
+            self._buffers.setdefault(key, [])
+            rec.cell.tell(cmd)
+            return True
+
+    def _finish_transition(self, key: str) -> List[Any]:
+        """Drop the record for a completed transition and return the
+        messages buffered during it.  An ACTIVE record is left alone —
+        a bounced handoff re-activates the key locally BEFORE its
+        self-ack lands here, and popping the live record would orphan
+        the cell."""
+        with self._lock:
+            rec = self._entities.get(key)
+            if rec is not None and rec.status != _ACTIVE:
+                self._entities.pop(key)
+            return self._buffers.pop(key, [])
+
+    def _reactivate(self, key: str, snapshot: Any, pending: List[Any],
+                    migrated: bool = False) -> None:
+        """Install a fresh cell for ``key`` (post-migration apply, or a
+        passivation that raced with new traffic) and deliver pending."""
+        with self._lock:
+            buffered = self._buffers.pop(key, [])
+            cell = self._spawn(
+                key, snapshot, resumed=snapshot is not None, migrated=migrated
+            )
+            self._entities[key] = _EntityRecord(cell)
+            for payload in pending:
+                cell.tell(payload)
+            for payload in buffered:
+                cell.tell(payload)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "type": self.type_name,
+            "active": self.active_count(),
+            "passivated": self.passive_count(),
+            "buffered": self.buffered_depth(),
+        }
+
+
+_SAFE_NAME = re.compile(r"[^A-Za-z0-9_.-]")
+
+
+def _safe_name(key: str) -> str:
+    return _SAFE_NAME.sub("_", key)[:48]
+
+
+# ------------------------------------------------------------------- #
+# Coordinator cell messages
+# ------------------------------------------------------------------- #
+
+
+class _Tick:
+    __slots__ = ()
+
+
+class _Rebalance:
+    __slots__ = ()
+
+
+class _FrameMsg:
+    __slots__ = ("from_address", "frame")
+
+    def __init__(self, from_address: str, frame: tuple):
+        self.from_address = from_address
+        self.frame = frame
+
+
+class _Coordinator(RawBehavior):
+    """Unmanaged, pinned cell serializing all cluster control work:
+    membership events, gossip, migration control, passivation scans.
+    Keeps the control plane single-threaded the same way the Bookkeeper
+    keeps the collector single-threaded."""
+
+    def __init__(self, cluster: "ClusterSharding"):
+        self.cluster = cluster
+
+    def on_message(self, msg: Any) -> Any:
+        cluster = self.cluster
+        if isinstance(msg, MemberUp):
+            cluster._member_up(msg.address)
+        elif isinstance(msg, MemberRemoved):
+            cluster._member_removed(msg.address)
+        elif isinstance(msg, _Tick):
+            cluster._tick()
+        elif isinstance(msg, _Rebalance):
+            cluster._recompute_table(force=True)
+        elif isinstance(msg, _FrameMsg):
+            cluster._handle_frame(msg.from_address, msg.frame)
+        return None
+
+
+class _CodecFacade:
+    """Resolution context for cluster payload decode: token resolution
+    delegates to the real fabric, but ``.system`` is pinned to the
+    receiving system so ``(entity)`` tokens bind to the local cluster
+    even on the in-process Fabric (which hosts several systems and has
+    no single ``.system``)."""
+
+    def __init__(self, fabric: Any, system: "ActorSystem"):
+        self._fabric = fabric
+        self.system = system
+        self.systems = (
+            fabric.systems if fabric is not None else {system.address: system}
+        )
+
+    def resolve_cell_token(self, address: str, uid: int):
+        hook = getattr(self._fabric, "resolve_cell_token", None)
+        if hook is not None:
+            return hook(address, uid)
+        system = self.systems.get(address)
+        if system is None:
+            raise LookupError(f"unknown system {address!r} on this fabric")
+        cell = system.resolve_cell(uid)
+        if cell is None:
+            raise LookupError(f"no cell uid={uid} in {address!r}")
+        return cell
+
+
+# ------------------------------------------------------------------- #
+# ClusterSharding: the per-system composition root
+# ------------------------------------------------------------------- #
+
+
+class ClusterSharding:
+    """Attach to a system (``ClusterSharding.attach(system)``), then
+    ``start(type_name, factory)`` entity types and address them through
+    :meth:`entity_ref`.  Works over the cross-process ``NodeFabric``
+    (shard/entity/migration traffic as wire frames), the in-process
+    ``Fabric`` (direct peer-region hand-off, same codec discipline),
+    and fabric-less single systems (everything local)."""
+
+    def __init__(self, system: "ActorSystem", num_shards: Optional[int] = None):
+        config = system.config
+        self.system = system
+        self.address = system.address
+        self.num_shards = num_shards or config.get_int("uigc.cluster.num-shards")
+        self.passivate_after_s = config.get_int("uigc.cluster.passivate-after") / 1000.0
+        self.tick_s = config.get_int("uigc.cluster.tick-interval") / 1000.0
+        self.retry_s = config.get_int("uigc.cluster.handoff-retry") / 1000.0
+        self.max_hops = config.get_int("uigc.cluster.max-forward-hops")
+        self.hold_timeout_s = config.get_int("uigc.cluster.hold-timeout") / 1000.0
+
+        self._lock = threading.RLock()
+        self._regions: Dict[str, ShardRegion] = {}
+        self._members: set = {self.address}
+        self._table = ShardTable(0, self.address, {})
+        self._name_seq = itertools.count(1)
+        #: routes that could not be sent (no link yet / table vacuum /
+        #: hop limit) — retried every tick instead of being dropped
+        self._deferred: List[Tuple[str, str, Any]] = []
+        #: shard-grant protocol state.  A shard GAINED from a live
+        #: previous owner is *held*: its traffic buffers here until the
+        #: previous owner grants it (all its handoffs acked), it dies,
+        #: or the hold times out.  Without the hold, traffic during the
+        #: table-divergence window can spawn a fresh on-demand entity
+        #: at the new home that then WINS against the in-flight
+        #: migration snapshot — silently discarding the entity's state.
+        self._holds: Dict[int, str] = {}
+        self._hold_deadlines: Dict[int, float] = {}
+        self._hold_buffers: Dict[int, List[Tuple[str, str, Any]]] = {}
+        #: shards we LOST: new owner plus the (type, key) handoffs that
+        #: must complete before we grant the shard away.
+        self._grant_watch: Dict[int, _GrantWatch] = {}
+        #: True while the table was computed from a single-member view
+        #: (the seed).  Self-ownership "confirmed" out of a provisional
+        #: table is NOT trustworthy — a joining node claims the whole
+        #: keyspace for a moment — so those shards are held too.
+        self._provisional = True
+        self._closed = False
+        self._ticks = 0
+        #: last table version rebroadcast by the anti-entropy gossip
+        self._gossiped_version = -1
+
+        from .migration import MigrationManager
+
+        self.migrations = MigrationManager(self)
+
+        fabric = system.fabric
+        self._codec = _CodecFacade(fabric, system)
+        self._wire_frames = fabric is not None and hasattr(fabric, "send_frame")
+        if self._wire_frames:
+            for kind in wire.SHARD_FRAME_KINDS:
+                fabric.register_frame_handler(kind, self._on_transport_frame)
+
+        self._coordinator = system.spawn_system_raw(
+            _Coordinator(self), "shard-coordinator", pinned=True
+        )
+        if fabric is not None:
+            fabric.subscribe(self._coordinator)
+        # Seed the table from the members known right now (at least
+        # self).  The subscribe replay above delivers current peers
+        # asynchronously; each one recomputes.  Without this seed a
+        # single node defers every route until a SECOND member joins —
+        # the MemberUp(self) replay dedups against the pre-seeded set.
+        self._recompute_table()
+        self._timer_key = ("cluster-tick", id(self))
+        system.timers.schedule_fixed_delay(
+            self.tick_s,
+            lambda: self._coordinator.tell(_Tick()),
+            key=self._timer_key,
+        )
+
+    # -- lifecycle --------------------------------------------------- #
+
+    @classmethod
+    def attach(
+        cls, system: "ActorSystem", num_shards: Optional[int] = None
+    ) -> "ClusterSharding":
+        sharding = cls(system, num_shards)
+        system.cluster = sharding
+        return sharding
+
+    def close(self) -> None:
+        self._closed = True
+        self.system.timers.cancel(self._timer_key)
+        fabric = self.system.fabric
+        if self._wire_frames:
+            for kind in wire.SHARD_FRAME_KINDS:
+                fabric.register_frame_handler(kind, None)
+        if self.system.cluster is self:
+            self.system.cluster = None
+
+    # -- entity types ------------------------------------------------ #
+
+    def start(
+        self,
+        type_name: str,
+        factory: EntityFactory,
+        passivate_after_s: Optional[float] = None,
+    ) -> ShardRegion:
+        """Register an entity type; returns its local region.  Every
+        node of the cluster must start the same types (the same
+        requirement Akka Cluster Sharding imposes)."""
+        with self._lock:
+            if type_name in self._regions:
+                raise ValueError(f"entity type {type_name!r} already started")
+            region = ShardRegion(self, type_name, factory, passivate_after_s)
+            self._regions[type_name] = region
+            return region
+
+    def region(self, type_name: str) -> ShardRegion:
+        with self._lock:
+            return self._regions[type_name]
+
+    def entity_ref(self, type_name: str, key: str) -> EntityRef:
+        return EntityRef(self, type_name, key)
+
+    # -- placement --------------------------------------------------- #
+
+    def home_of(self, key: str) -> Optional[str]:
+        return self._table.owner(shard_of(key, self.num_shards))
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def table_snapshot(self) -> ShardTable:
+        t = self._table
+        return ShardTable(t.version, t.origin, dict(t.assignments))
+
+    # -- routing ----------------------------------------------------- #
+
+    def route(self, type_name: str, key: str, payload: Any, hops: int = 0) -> None:
+        """Deliver ``payload`` to the entity for ``key`` wherever it
+        currently lives."""
+        shard = shard_of(key, self.num_shards)
+        home = self._table.owner(shard)
+        if home is None:
+            self._defer(type_name, key, payload)
+            return
+        if home == self.address:
+            with self._lock:
+                if shard in self._holds:
+                    # Shard gained but not yet granted: hold the
+                    # message so an on-demand spawn cannot race (and
+                    # discard) the in-flight migration snapshot.
+                    buf = self._hold_buffers.setdefault(shard, [])
+                    buf.append((type_name, key, payload))
+                    held = len(buf)
+                else:
+                    held = 0
+            if held:
+                if events.recorder.enabled:
+                    events.recorder.commit(
+                        events.SHARD_HANDOFF_BUFFERED,
+                        key=key,
+                        type=type_name,
+                        depth=held,
+                        shard=shard,
+                    )
+                return
+            region = self._regions.get(type_name)
+            if region is None:
+                self._defer(type_name, key, payload)
+                return
+            region.deliver_local(key, payload)
+            return
+        if hops >= self.max_hops:
+            # Tables are diverging (a rebalance in flight); park the
+            # message until gossip converges rather than ping-ponging.
+            self._defer(type_name, key, payload)
+            return
+        encoded = wire.encode_message(payload)
+        if not self._send_frame(
+            home, wire.encode_entity_frame(type_name, key, hops + 1, encoded)
+        ):
+            self._defer(type_name, key, payload)
+
+    def _defer(self, type_name: str, key: str, payload: Any) -> None:
+        with self._lock:
+            self._deferred.append((type_name, key, payload))
+
+    # -- transport --------------------------------------------------- #
+
+    def _send_frame(self, dst: str, frame: tuple) -> bool:
+        if dst == self.address:
+            self._coordinator.tell(_FrameMsg(self.address, frame))
+            return True
+        fabric = self.system.fabric
+        if fabric is None:
+            return False
+        if self._wire_frames:
+            return fabric.send_frame(dst, frame)
+        peer = fabric.systems.get(dst)
+        cluster = getattr(peer, "cluster", None)
+        if cluster is None or getattr(peer, "address", None) in fabric.crashed:
+            return False
+        cluster._coordinator.tell(_FrameMsg(self.address, frame))
+        return True
+
+    def _on_transport_frame(self, from_address: str, frame: tuple) -> None:
+        # Transport receive thread: hop onto the coordinator so all
+        # control work is serialized on one cell.
+        self._coordinator.tell(_FrameMsg(from_address, frame))
+
+    # -- coordinator-side handlers ----------------------------------- #
+
+    def _member_up(self, address: str) -> None:
+        with self._lock:
+            if address in self._members:
+                return
+            self._members.add(address)
+        self._recompute_table()
+
+    def _member_removed(self, address: str) -> None:
+        with self._lock:
+            if address not in self._members:
+                return
+            self._members.discard(address)
+            # Holds waiting on the dead node release immediately (its
+            # grant will never come — and its state died with it);
+            # grant watches pointing at it are obsolete, the recompute
+            # below re-targets those shards.
+            for shard in [
+                s for s, owner in self._holds.items() if owner == address
+            ]:
+                self._release_hold_locked(shard)
+            for shard in [
+                s
+                for s, watch in self._grant_watch.items()
+                if watch.owner == address
+            ]:
+                del self._grant_watch[shard]
+        self._recompute_table()
+        self.migrations.retarget_dead(address)
+        self._flush_deferred()
+
+    def rebalance(self) -> None:
+        """Explicit rebalance kick: recompute from the current member
+        view, gossip, and hand off anything this node no longer owns.
+        Routed through the coordinator so table transitions stay
+        single-threaded — a caller-thread recompute could race the
+        coordinator's grant pass into granting a freshly lost shard
+        before its keys are registered."""
+        self._coordinator.tell(_Rebalance())
+
+    def _recompute_table(self, force: bool = False) -> None:
+        with self._lock:
+            assignments = rendezvous_assign(sorted(self._members), self.num_shards)
+            if assignments == self._table.assignments and not force:
+                return
+            old = self._table.assignments
+            self._table = ShardTable(
+                self._table.version + 1, self.address, assignments
+            )
+            table = self._table
+            self._table_transition(old, assignments)
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SHARD_TABLE,
+                version=table.version,
+                shards=len(table.assignments),
+                origin=self.address,
+            )
+        self._gossip()
+        self._scan_handoffs()
+
+    def _adopt_table(self, version: int, origin: str, assignments: Dict[int, str]) -> None:
+        incoming = ShardTable(version, origin, assignments)
+        with self._lock:
+            if not incoming.supersedes(self._table):
+                return
+            old = self._table.assignments
+            self._table = incoming
+            self._table_transition(old, assignments)
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SHARD_TABLE,
+                version=version,
+                shards=len(assignments),
+                origin=origin,
+            )
+        self._scan_handoffs()
+
+    def _table_transition(self, old: Dict[int, str], new: Dict[int, str]) -> None:
+        """Shard-grant bookkeeping for one table change (caller holds
+        the lock): hold every shard we GAIN from a live previous owner
+        until that owner grants it; watch every shard we LOSE so we can
+        grant it once our handoffs for it complete."""
+        now = time.monotonic()
+        was_provisional = self._provisional
+        new_provisional = len(self._members) <= 1
+        self._provisional = new_provisional
+        if new_provisional:
+            # Sole member again: there is nobody left to wait on.
+            for shard in list(self._holds):
+                self._release_hold_locked(shard)
+            return
+        for shard, owner in new.items():
+            prev = old.get(shard)
+            if owner == self.address:
+                if (
+                    prev is not None
+                    and prev != self.address
+                    and prev in self._members
+                ):
+                    # Gained from a live previous owner: hold until ITS
+                    # grant (or death, or timeout).
+                    self._holds[shard] = prev
+                    self._hold_deadlines[shard] = now + self.hold_timeout_s
+                elif (
+                    prev == self.address
+                    and was_provisional
+                    and not new_provisional
+                    and shard not in self._holds
+                ):
+                    # "Confirmed" to self out of the seed table: a node
+                    # that just joined claimed the whole keyspace for a
+                    # moment, so this ownership is not evidence that no
+                    # peer is migrating the shard's entities to us.
+                    # Hold for ANY peer's grant (owner None = any).
+                    self._holds[shard] = None  # type: ignore[assignment]
+                    self._hold_deadlines[shard] = now + self.hold_timeout_s
+            elif shard in self._holds:
+                # The shard moved on before we were granted it: whatever
+                # we were holding belongs elsewhere now — re-route it.
+                self._release_hold_locked(shard)
+        for shard, prev in old.items():
+            if prev == self.address and new.get(shard) != self.address:
+                new_owner = new.get(shard)
+                if new_owner is not None:
+                    self._grant_watch[shard] = _GrantWatch(new_owner)
+                self._holds.pop(shard, None)
+                self._hold_deadlines.pop(shard, None)
+
+    def _release_hold_locked(self, shard: int) -> None:
+        """Caller holds the lock.  Clears the hold; buffered traffic is
+        moved to the deferred queue (flushed next tick, re-routed by
+        the then-current table)."""
+        self._holds.pop(shard, None)
+        self._hold_deadlines.pop(shard, None)
+        for type_name, key, payload in self._hold_buffers.pop(shard, []):
+            self._deferred.append((type_name, key, payload))
+
+    def _release_hold(self, shard: int) -> None:
+        with self._lock:
+            self._release_hold_locked(shard)
+        self._flush_deferred()
+
+    def _flush_deferred(self) -> None:
+        with self._lock:
+            deferred, self._deferred = self._deferred, []
+        for type_name, key, payload in deferred:
+            self.route(type_name, key, payload)
+
+    def _handoff_done(self, type_name: str, key: str) -> None:
+        """MigrationManager callback: one outbound handoff acked.  When
+        every handoff of a lost shard is done, grant the shard away."""
+        shard = shard_of(key, self.num_shards)
+        grant_to = None
+        with self._lock:
+            watch = self._grant_watch.get(shard)
+            if watch is not None:
+                watch.keys.discard((type_name, key))
+                if not watch.keys and watch.scanned:
+                    grant_to = watch.owner
+                    del self._grant_watch[shard]
+        if grant_to is not None:
+            self._send_frame(
+                grant_to, wire.encode_shard_grant(shard, self.address)
+            )
+
+    def _gossip(self) -> None:
+        table = self._table
+        self._gossiped_version = table.version
+        frame = wire.encode_shard_frame(table.version, table.origin, table.assignments)
+        for member in self.members():
+            if member != self.address:
+                self._send_frame(member, frame)
+
+    def _scan_handoffs(self) -> None:
+        """Hand off everything this node no longer owns: live entities
+        migrate with their state, PASSIVATED entities ship their spilled
+        snapshot (otherwise the store copy strands on the old owner and
+        the new owner would recreate the entity blank)."""
+        with self._lock:
+            regions = list(self._regions.values())
+        for region in regions:
+            type_name = region.type_name
+            for key in region.record_keys():
+                if self._moves_away(key):
+                    # Register EVERY record (active or mid-transition)
+                    # against the grant watch; begin() is a no-op for
+                    # non-ACTIVE records — a key mid-handoff resolves
+                    # through its ack, a key mid-passivation spills to
+                    # the store and ships on the next tick.
+                    self._watch_key(type_name, key)
+                    self.migrations.begin(region, key)
+            for key in region.store.keys():
+                if self._moves_away(key):
+                    self._watch_key(type_name, key)
+                    self.migrations.ship_passive(region, key)
+        with self._lock:
+            # The scan enumerated every region: watches are now fully
+            # populated and may be granted once their keys drain.
+            for watch in self._grant_watch.values():
+                watch.scanned = True
+        self._grant_ready()
+
+    def _moves_away(self, key: str) -> bool:
+        home = self.home_of(key)
+        return home is not None and home != self.address
+
+    def _watch_key(self, type_name: str, key: str) -> None:
+        """Register an outbound handoff against its shard's grant watch
+        BEFORE starting it, so the ack can never race the registration."""
+        shard = shard_of(key, self.num_shards)
+        with self._lock:
+            watch = self._grant_watch.get(shard)
+            if watch is not None:
+                watch.keys.add((type_name, key))
+
+    def _key_outstanding(self, type_name: str, key: str) -> bool:
+        """Is any trace of this key still on this node (an unacked
+        handoff, a live/transitioning record, a stored snapshot)?"""
+        if self.migrations.is_pending(type_name, key):
+            return True
+        region = self._regions.get(type_name)
+        if region is None:
+            return False
+        with region._lock:
+            if key in region._entities:
+                return True
+        return region.store.contains(key)
+
+    def _grant_ready(self) -> None:
+        """Grant away every lost shard with no outstanding handoffs
+        (pruning keys that already left by other means).  The
+        outstanding probes take region locks, so they run OUTSIDE the
+        cluster lock — an entity constructor may hold a region lock
+        while routing (which takes the cluster lock), and nesting the
+        other way around would deadlock."""
+        with self._lock:
+            snapshot = {
+                s: set(w.keys)
+                for s, w in self._grant_watch.items()
+                if w.scanned
+            }
+        if not snapshot:
+            return
+        still_map = {
+            shard: {(t, k) for (t, k) in keys if self._key_outstanding(t, k)}
+            for shard, keys in snapshot.items()
+        }
+        ready: List[Tuple[int, str]] = []
+        with self._lock:
+            for shard, watch in list(self._grant_watch.items()):
+                checked = still_map.get(shard)
+                if checked is None:
+                    continue
+                # keys registered since the snapshot stay outstanding
+                watch.keys = checked | (watch.keys - snapshot[shard])
+                if not watch.keys:
+                    del self._grant_watch[shard]
+                    ready.append((shard, watch.owner))
+        for shard, owner in ready:
+            self._send_frame(owner, wire.encode_shard_grant(shard, self.address))
+
+    def _tick(self) -> None:
+        if self._closed:
+            return
+        self._ticks += 1
+        # Anti-entropy gossip heals dropped gossip frames, but a quiet
+        # cluster does not need the full table rebroadcast 10x/second:
+        # gossip immediately when the version moved, else every 5th tick.
+        if self._table.version != self._gossiped_version or self._ticks % 5 == 0:
+            self._gossip()
+        self.migrations.retry_due()
+        now = time.monotonic()
+        with self._lock:
+            regions = list(self._regions.values())
+            multi_member = len(self._members) > 1
+            for shard in [
+                s for s, d in self._hold_deadlines.items() if d <= now
+            ]:
+                # Safety valve: a grant that never arrives (lost frame
+                # from a wedged-but-not-dead peer) must not hold the
+                # shard's traffic forever.
+                self._release_hold_locked(shard)
+        for region in regions:
+            region.passivation.scan(region)
+            # Late spills: a snapshot that landed in the store AFTER
+            # the rebalance scan (its key was mid-passivation then)
+            # still belongs elsewhere — ship it now.  Single-member
+            # clusters skip the walk: nothing can move away.
+            if multi_member:
+                for key in region.store.keys():
+                    if self._moves_away(key):
+                        self._watch_key(region.type_name, key)
+                        self.migrations.ship_passive(region, key)
+        self._grant_ready()
+        self._flush_deferred()
+
+    def _handle_frame(self, from_address: str, frame: tuple) -> None:
+        kind = frame[0]
+        if kind == "shard":
+            decoded = wire.decode_shard_frame(frame)
+            if decoded is not None:
+                self._adopt_table(*decoded)
+        elif kind == "ent":
+            decoded = wire.decode_entity_frame(frame)
+            if decoded is None:
+                return
+            type_name, key, hops, payload_bytes = decoded
+            try:
+                payload = wire.decode_message(self._codec, payload_bytes)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                return
+            if self.home_of(key) != self.address and events.recorder.enabled:
+                events.recorder.commit(
+                    events.SHARD_FORWARDED, key=key, type=type_name, hops=hops
+                )
+            self.route(type_name, key, payload, hops=hops)
+        elif kind == "mig":
+            self.migrations.apply_incoming(from_address, frame)
+        elif kind == "miga":
+            self.migrations.on_ack(frame)
+        elif kind == "sgrant":
+            decoded = wire.decode_shard_grant(frame)
+            if decoded is None:
+                return
+            shard, origin = decoded
+            with self._lock:
+                holder = self._holds.get(shard, _NOT_HELD)
+                granted = holder is not _NOT_HELD and (
+                    holder is None or holder == origin
+                )
+            if granted:
+                self._release_hold(shard)
+
+    # -- observability ----------------------------------------------- #
+
+    def gauge_value(self, field: str) -> Optional[float]:
+        """Cheap single-field read for the telemetry gauges — a metrics
+        scrape polls six fields, and rebuilding the full :meth:`stats`
+        walk (every region lock + the migration lock) per gauge would
+        multiply lock contention on the routing path for nothing."""
+        if field == "table_size":
+            return len(self._table.assignments)
+        if field == "table_version":
+            return self._table.version
+        if field == "migrations_pending":
+            return self.migrations.pending_count()
+        with self._lock:
+            regions = list(self._regions.values())
+        if field == "active":
+            return sum(r.active_count() for r in regions)
+        if field == "passivated":
+            return sum(r.passive_count() for r in regions)
+        if field == "buffered":
+            return sum(r.buffered_depth() for r in regions)
+        return None
+
+    def stats(self) -> Dict[str, Any]:
+        # Region counters are read OUTSIDE the cluster lock (same
+        # ordering rule as _grant_ready: region locks never nest inside
+        # the cluster lock).
+        with self._lock:
+            regions = list(self._regions.values())
+            table = self._table
+            held = len(self._holds)
+        return {
+            "table_version": table.version,
+            "table_size": len(table.assignments),
+            "held_shards": held,
+            "members": self.members(),
+            "active": sum(r.active_count() for r in regions),
+            "passivated": sum(r.passive_count() for r in regions),
+            "buffered": sum(r.buffered_depth() for r in regions),
+            "migrations_pending": self.migrations.pending_count(),
+            "regions": [r.stats() for r in regions],
+        }

@@ -96,6 +96,33 @@ DEFAULTS: Dict[str, Any] = {
     # Milliseconds of backoff before the first reconnect attempt,
     # doubled per attempt.
     "uigc.node.reconnect-backoff": 50,
+    # --- Cluster sharding (uigc_tpu/cluster; no reference analogue —
+    # the reference stops at GC middleware, this is the serving layer
+    # above it) ---
+    # Shards in the key space.  Placement is rendezvous hashing of
+    # shards over members, so this bounds rebalance granularity: more
+    # shards = finer-grained, smoother rebalances.
+    "uigc.cluster.num-shards": 32,
+    # Milliseconds of mailbox idleness after which an entity passivates
+    # (state spilled to the region's store, cell stopped, recreated on
+    # next send).  0 disables passivation.
+    "uigc.cluster.passivate-after": 0,
+    # Milliseconds between cluster coordinator ticks (anti-entropy
+    # shard-table gossip, migration retries, passivation scans,
+    # deferred-route flushes).
+    "uigc.cluster.tick-interval": 100,
+    # Milliseconds before an unacked entity handoff is re-shipped (the
+    # at-least-once leg of the migration protocol; the receiver dedups).
+    "uigc.cluster.handoff-retry": 300,
+    # Entity-message forward hops before a message is parked for the
+    # next tick instead of ping-ponging between diverging shard tables.
+    "uigc.cluster.max-forward-hops": 8,
+    # Milliseconds a newly GAINED shard's traffic is held waiting for
+    # the previous owner's grant (the handoff-completion signal) before
+    # the hold times out.  The hold is what stops traffic during a
+    # rebalance from spawning a fresh on-demand entity that would win
+    # against — and silently discard — the in-flight migrated state.
+    "uigc.cluster.hold-timeout": 3000,
     # --- Correctness tooling (uigc_tpu/analysis; no reference analogue,
     # the reference debugged with in-source asserts) ---
     # Attach the uigcsan online sanitizer at system creation: a shadow

@@ -60,6 +60,20 @@ class EngineTap:
         """The engine decided ``cell`` SHOULD_STOP after processing
         ``msg`` (called by the runtime before the stop is initiated)."""
 
+    def on_migrate_out(self, cell: "ActorCell", key: str) -> None:
+        """``cell`` (a sharded entity, uigc_tpu/cluster) captured its
+        state for a live migration and is about to stop.  Its remaining
+        local send/recv balance moves to another node's books, so local
+        balance comparisons for it are meaningless from here on — the
+        sanitizer taints it, exactly like a message that crossed a node
+        boundary."""
+
+    def on_migrate_in(self, cell: "ActorCell", key: str) -> None:
+        """``cell`` was reconstructed from a migrated snapshot.  Its
+        history (creates/sends recorded under the old incarnation's uid)
+        lives on another node; local ground-truth counters must not be
+        compared against it."""
+
 
 class Engine:
     """A GC engine: a collection of hooks and datatypes used by the

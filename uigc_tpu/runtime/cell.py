@@ -21,6 +21,7 @@ Invariants:
 from __future__ import annotations
 
 import threading
+import time
 import traceback
 from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
@@ -85,6 +86,7 @@ class ActorCell:
         "_dispatcher",
         "_needs_block_hook",
         "on_finished_processing",
+        "_last_active",
         "_anon_counter",
         "__weakref__",  # the wire codec's uid registry holds cells weakly
     )
@@ -123,6 +125,10 @@ class ActorCell:
         # engines get an initial entry even from never-messaged actors.
         self._needs_block_hook = True
         self.on_finished_processing: Optional[Callable[[], None]] = None
+        #: monotonic stamp of the last mailbox activity (enqueue or a
+        #: processed batch) — the idle clock that drives entity
+        #: passivation (uigc_tpu/cluster/passivation.py).
+        self._last_active = time.monotonic()
         self._anon_counter = 0
 
     # ------------------------------------------------------------------ #
@@ -138,6 +144,7 @@ class ActorCell:
             else:
                 dead = False
                 self._mailbox.append(msg)
+                self._last_active = time.monotonic()
                 dispatch = self._mark_scheduled()
         if dead:
             self.system.record_dead_letter(self, msg)
@@ -248,6 +255,9 @@ class ActorCell:
                 # like Akka typed's default supervision.
                 traceback.print_exc()
                 self._initiate_stop()
+
+        if processed:
+            self._last_active = time.monotonic()
 
         # Mailbox drained while active: fire the finished-processing hook
         # (the forked-Akka ``onFinishedProcessingHook`` analogue) before we
@@ -482,6 +492,16 @@ class ActorCell:
     # ------------------------------------------------------------------ #
     # Watch / misc
     # ------------------------------------------------------------------ #
+
+    def idle_seconds(self) -> float:
+        """Seconds since the last enqueue or processed batch.  Combined
+        with an empty-mailbox check this is the quiescence signal the
+        passivation policy reads (uigc_tpu/cluster/passivation.py)."""
+        return time.monotonic() - self._last_active
+
+    def mailbox_size(self) -> int:
+        with self._lock:
+            return len(self._mailbox)
 
     def drain_mailbox(self) -> list:
         """Atomically remove and return all pending application messages.

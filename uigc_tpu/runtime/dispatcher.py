@@ -49,6 +49,11 @@ class Dispatcher:
         if not self._shutdown:
             self._queue.put(runnable)
 
+    def queue_depth(self) -> int:
+        """Batches waiting for a worker — the scheduling-pressure gauge
+        (``uigc_dispatcher_depth``; approximate by nature)."""
+        return self._queue.qsize()
+
     def _run(self) -> None:
         events.set_thread_origin(self._origin)
         while True:

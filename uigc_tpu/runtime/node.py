@@ -64,6 +64,7 @@ import traceback
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils import events
+from ..utils.validation import InvariantViolation
 from . import faults, wire
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -71,6 +72,20 @@ if TYPE_CHECKING:  # pragma: no cover
     from .system import ActorSystem
 
 from .fabric import MemberRemoved, MemberUp
+
+
+class DuplicateNameError(InvariantViolation):
+    """A well-known name was registered twice for different cells.  The
+    old behavior silently overwrote the first registration, so peers
+    that looked the name up before and after the overwrite resolved two
+    different actors under one name — a split-brain address."""
+
+
+class NameLookupError(InvariantViolation, KeyError):
+    """A well-known name could not be resolved from a peer's hello.
+    Subclasses ``KeyError`` so existing wait-for-hello retry loops keep
+    working; carries the structured (address, name) evidence and is
+    preceded by a ``fabric.lookup_miss`` event."""
 
 
 class ProxySystem:
@@ -292,6 +307,12 @@ class NodeFabric:
         self._peer_names: Dict[str, Dict[str, int]] = {}
         self._conns: Dict[str, _Conn] = {}
         self._proxies: Dict[Tuple[str, int], ProxyCell] = {}
+        #: subsystem frame dispatch (kind -> fn(from_address, frame)):
+        #: how layers above the transport (cluster sharding) receive
+        #: their own frame kinds without the transport knowing them.
+        #: Unregistered kinds are ignored after seq accounting — the
+        #: version-tolerance contract old peers rely on.
+        self._frame_handlers: Dict[str, Callable[[str, tuple], None]] = {}
         self._out: Dict[str, _HalfLink] = {}
         self._in: Dict[str, _HalfLink] = {}
         self._peers: Dict[str, _PeerState] = {}
@@ -352,11 +373,39 @@ class NodeFabric:
 
     def register_name(self, name: str, cell: Any) -> None:
         """Advertise a well-known local cell (exchanged in the hello
-        frame, the analogue of an actor selection path)."""
-        self._names[name] = cell
+        frame, the analogue of an actor selection path).  Registering a
+        DIFFERENT cell under an existing name raises — a silent
+        overwrite would hand peers two actors for one name.
+        Re-registering the same cell is an idempotent no-op."""
+        with self._lock:
+            existing = self._names.get(name)
+            if existing is not None and existing is not cell:
+                raise DuplicateNameError(
+                    "fabric.name_duplicate",
+                    "well-known name registered twice for different cells",
+                    name=name,
+                    existing=getattr(existing, "path", repr(existing)),
+                    requested=getattr(cell, "path", repr(cell)),
+                )
+            self._names[name] = cell
 
     def lookup(self, address: str, name: str) -> ProxyCell:
-        uid = self._peer_names[address][name]
+        """Resolve a peer's well-known name to its cached proxy.  A name
+        the peer's hello never advertised (or an address we have no
+        hello from) does NOT fabricate a proxy for a nonexistent uid —
+        it emits ``fabric.lookup_miss`` and raises, so the caller can
+        retry once the hello lands instead of silently sending into a
+        permanent dead-letter sink."""
+        with self._lock:
+            uid = self._peer_names.get(address, {}).get(name)
+        if uid is None:
+            events.recorder.commit(events.LOOKUP_MISS, address=address, lookup=name)
+            raise NameLookupError(
+                "fabric.lookup_miss",
+                "well-known name not resolved by the peer",
+                address=address,
+                name=name,
+            )
         return self._proxy(address, uid)
 
     def _proxy(self, address: str, uid: int) -> ProxyCell:
@@ -507,6 +556,33 @@ class NodeFabric:
     def _live_peers(self) -> List[str]:
         with self._lock:
             return [a for a in self._conns if a not in self.crashed]
+
+    # ------------------------------------------------------------- #
+    # Subsystem frames (cluster sharding and future layers)
+    # ------------------------------------------------------------- #
+
+    def register_frame_handler(
+        self, kind: str, handler: Optional[Callable[[str, tuple], None]]
+    ) -> None:
+        """Install (or with ``None`` remove) the receiver for a custom
+        frame kind.  The handler runs on the link's receive thread with
+        the full frame tuple; it must tolerate trailing elements it does
+        not understand (the same contract as the app-frame trace
+        header)."""
+        with self._lock:
+            if handler is None:
+                self._frame_handlers.pop(kind, None)
+            else:
+                self._frame_handlers[kind] = handler
+
+    def send_frame(self, dst_address: str, inner: tuple) -> bool:
+        """Transmit one subsystem frame to a live peer through the
+        sequence layer and the fault plan (the same path app frames
+        ride).  Returns False when there is no live link."""
+        conn = self._conn_for(dst_address)
+        if conn is None:
+            return False
+        return self._send_frame(dst_address, inner, conn)
 
     # ------------------------------------------------------------- #
     # Frame transmission (seq layer + fault injection)
@@ -961,6 +1037,13 @@ class NodeFabric:
             self.system.engine.bookkeeper_cell.tell(
                 wire.decode_message(self, frame[1])
             )
+        else:
+            handler = self._frame_handlers.get(kind)
+            if handler is not None:
+                handler(from_address, frame)
+            # else: unknown kind from a newer peer — ignored by design
+            # (the seq layer already accounted the frame, so sequence
+            # numbers stay in step with the sender).
 
     # ------------------------------------------------------------- #
 

@@ -94,6 +94,10 @@ class ActorSystem:
         #: guardians/engine exist: dispatcher threads read this
         #: attribute as soon as the first cell processes a message.
         self.telemetry: Optional[Any] = None
+        #: Cluster-sharding subsystem (uigc_tpu/cluster), attached via
+        #: ``ClusterSharding.attach(system)`` — API-driven (it needs
+        #: entity factories), unlike the config-driven attachments.
+        self.cluster: Optional[Any] = None
 
         # Top-level guardians (raw).
         self._system_guardian = self._make_raw_cell("system", None)
@@ -283,6 +287,10 @@ class ActorSystem:
         machinery."""
         import time
 
+        if self.cluster is not None:
+            # Stop cluster timers/handlers before the guardian teardown
+            # so no rebalance or passivation races the entity stops.
+            self.cluster.close()
         self._user_guardian.stop()
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline and not self._user_guardian.is_terminated:

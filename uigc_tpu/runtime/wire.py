@@ -69,6 +69,18 @@ def _proxy_cell_class():
     return _PROXY_CELL
 
 
+_ENTITY_REF = None
+
+
+def _entity_ref_class():
+    global _ENTITY_REF
+    if _ENTITY_REF is None:
+        from ..cluster.sharding import EntityRef
+
+        _ENTITY_REF = EntityRef
+    return _ENTITY_REF
+
+
 class _Pickler(pickle.Pickler):
     def persistent_id(self, obj: Any):
         from ..engines.crgc.refob import CrgcRefob
@@ -76,6 +88,12 @@ class _Pickler(pickle.Pickler):
         from .cell import ActorCell
         from .system import RawRef
 
+        if isinstance(obj, _entity_ref_class()):
+            # Location-transparent: an entity ref crosses as its
+            # (type, key) coordinates and re-binds to the DESTINATION
+            # node's shard region — never to a concrete cell, which may
+            # passivate or migrate while the message is in flight.
+            return ("entity", obj.type_name, obj.key)
         if isinstance(obj, CrgcRefob):
             t = obj._target
             return ("refob", t.system.address, t.uid)
@@ -101,6 +119,16 @@ class _Unpickler(pickle.Unpickler):
         self._fabric = fabric
 
     def persistent_load(self, pid):
+        if pid[0] == "entity":
+            _, type_name, key = pid
+            system = getattr(self._fabric, "system", None)
+            cluster = getattr(system, "cluster", None)
+            if cluster is None:
+                raise LookupError(
+                    f"entity ref {type_name}/{key}: no cluster sharding "
+                    "attached to the receiving system"
+                )
+            return cluster.entity_ref(type_name, key)
         kind, address, uid = pid
         cell = _resolve(self._fabric, address, uid)
         if kind == "refob":
@@ -181,3 +209,102 @@ def apply_trace_header(msg: Any, header: Any) -> None:
         msg.trace_ctx = header
     except AttributeError:
         pass
+
+
+# ------------------------------------------------------------------- #
+# Cluster-sharding frames (uigc_tpu/cluster)
+#
+# Four frame kinds ride the node transport's sequence layer next to the
+# app/marker/delta frames.  All of them follow the trace-header
+# discipline: decoders accept trailing elements they do not understand
+# (a newer peer may append fields), return None for anything malformed
+# (the frame is then dropped, never an exception on the link thread),
+# and a peer that does not know these kinds at all ignores them without
+# desyncing sequence numbers (runtime/node.py _on_frame else-branch).
+# ------------------------------------------------------------------- #
+
+#: Frame kinds owned by the cluster layer.
+SHARD_FRAME_KINDS = ("shard", "ent", "mig", "miga", "sgrant")
+
+
+def encode_shard_frame(version: int, origin: str, assignments: dict) -> tuple:
+    """Shard-table gossip: ``(kind, version, origin, {shard: address})``."""
+    return ("shard", int(version), origin, dict(assignments))
+
+
+def decode_shard_frame(frame: tuple):
+    """-> (version, origin, assignments) or None."""
+    try:
+        version, origin, assignments = frame[1], frame[2], frame[3]
+        if not isinstance(version, int) or not isinstance(assignments, dict):
+            return None
+        return version, str(origin), {int(s): str(a) for s, a in assignments.items()}
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_entity_frame(type_name: str, key: str, hops: int, payload: bytes) -> tuple:
+    """Entity-routed message: the payload bytes come from
+    :func:`encode_message` on the sender."""
+    return ("ent", type_name, key, int(hops), payload)
+
+
+def decode_entity_frame(frame: tuple):
+    """-> (type_name, key, hops, payload) or None."""
+    try:
+        type_name, key, hops, payload = frame[1], frame[2], frame[3], frame[4]
+        if not isinstance(payload, bytes):
+            return None
+        return str(type_name), str(key), int(hops), payload
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_migration_frame(
+    type_name: str, key: str, mig_id: tuple, blob: bytes
+) -> tuple:
+    """Handoff state transfer: ``blob`` is the encode_message bytes of a
+    ``(snapshot, pending_payloads)`` pair."""
+    return ("mig", type_name, key, tuple(mig_id), blob)
+
+
+def decode_migration_frame(frame: tuple):
+    """-> (type_name, key, mig_id, blob) or None."""
+    try:
+        type_name, key, mig_id, blob = frame[1], frame[2], frame[3], frame[4]
+        if not isinstance(blob, bytes) or not isinstance(mig_id, tuple):
+            return None
+        return str(type_name), str(key), mig_id, blob
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_shard_grant(shard: int, origin: str) -> tuple:
+    """Shard-ownership grant: the PREVIOUS owner of ``shard`` tells the
+    new owner that every entity it hosted for that shard has been
+    handed off — the new owner may stop holding the shard's traffic."""
+    return ("sgrant", int(shard), origin)
+
+
+def decode_shard_grant(frame: tuple):
+    """-> (shard, origin) or None."""
+    try:
+        shard, origin = frame[1], frame[2]
+        return int(shard), str(origin)
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_migration_ack(type_name: str, key: str, mig_id: tuple) -> tuple:
+    return ("miga", type_name, key, tuple(mig_id))
+
+
+def decode_migration_ack(frame: tuple):
+    """-> (type_name, key, mig_id) or None."""
+    try:
+        type_name, key, mig_id = frame[1], frame[2], frame[3]
+        if not isinstance(mig_id, tuple):
+            return None
+        return str(type_name), str(key), mig_id
+    except (IndexError, TypeError, ValueError):
+        return None

@@ -363,6 +363,36 @@ class EventMetricsBridge:
         self._merge_ingress_seconds = r.histogram(
             "uigc_merge_ingress_seconds", "Latency of folding one ingress entry."
         )
+        self._migration_seconds = r.histogram(
+            "uigc_shard_migration_seconds",
+            "Entity handoff latency, capture to ack (uigc_tpu/cluster).",
+        )
+        self._migrations = r.counter(
+            "uigc_shard_migrations_total", "Completed entity handoffs."
+        )
+        self._entity_activations = r.counter(
+            "uigc_shard_entity_activations_total",
+            "Entity cells constructed, by kind (fresh/resumed/migrated).",
+        )
+        self._entity_passivations = r.counter(
+            "uigc_shard_entity_passivations_total",
+            "Idle entities spilled to the passivation store.",
+        )
+        self._table_updates = r.counter(
+            "uigc_shard_table_updates_total", "Shard-table versions adopted."
+        )
+        self._forwards = r.counter(
+            "uigc_shard_forwards_total",
+            "Entity messages re-routed by a node that no longer owns the key.",
+        )
+        self._state_conflicts = r.counter(
+            "uigc_shard_state_conflicts_total",
+            "Migrated snapshots dropped against a resident incarnation.",
+        )
+        self._lookup_misses = r.counter(
+            "uigc_fabric_lookup_miss_total",
+            "Well-known-name lookups the peer's hello never resolved.",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -415,6 +445,27 @@ class EventMetricsBridge:
         elif name == events.MERGING_INGRESS_ENTRIES:
             if duration is not None:
                 self._merge_ingress_seconds.observe(duration)
+        elif name == events.SHARD_MIGRATION:
+            self._migrations.inc()
+            if duration is not None:
+                self._migration_seconds.observe(duration)
+        elif name == events.SHARD_ENTITY_ACTIVATED:
+            kind = (
+                "migrated"
+                if fields.get("migrated")
+                else "resumed" if fields.get("resumed") else "fresh"
+            )
+            self._entity_activations.inc(kind=kind)
+        elif name == events.SHARD_ENTITY_PASSIVATED:
+            self._entity_passivations.inc()
+        elif name == events.SHARD_TABLE:
+            self._table_updates.inc()
+        elif name == events.SHARD_FORWARDED:
+            self._forwards.inc()
+        elif name == events.SHARD_STATE_CONFLICT:
+            self._state_conflicts.inc()
+        elif name == events.LOOKUP_MISS:
+            self._lookup_misses.inc()
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:
@@ -471,6 +522,44 @@ def install_system_gauges(registry: MetricsRegistry, system: Any) -> None:
         "Messages in transit on the fabric's async queue.",
         fn=lambda: _transit_depth(system),
     )
+    registry.gauge(
+        "uigc_dispatcher_depth",
+        "Actor batches waiting for a dispatcher worker.",
+        fn=lambda: system.dispatcher.queue_depth(),
+    )
+    # Cluster-sharding gauges: lazy reads of ``system.cluster``, which
+    # attaches AFTER telemetry (it needs entity factories) — a callback
+    # returning None simply yields no sample until the cluster exists.
+    registry.gauge(
+        "uigc_shard_table_size",
+        "Shards assigned in the current shard table.",
+        fn=lambda: _cluster_stat(system, "table_size"),
+    )
+    registry.gauge(
+        "uigc_shard_table_version",
+        "Version of the adopted shard table.",
+        fn=lambda: _cluster_stat(system, "table_version"),
+    )
+    registry.gauge(
+        "uigc_shard_entities_active",
+        "Live entity cells hosted by this node's shard regions.",
+        fn=lambda: _cluster_stat(system, "active"),
+    )
+    registry.gauge(
+        "uigc_shard_entities_passivated",
+        "Entity snapshots resting in the passivation store.",
+        fn=lambda: _cluster_stat(system, "passivated"),
+    )
+    registry.gauge(
+        "uigc_shard_handoff_buffered",
+        "Messages buffered behind in-flight handoffs/passivations.",
+        fn=lambda: _cluster_stat(system, "buffered"),
+    )
+    registry.gauge(
+        "uigc_shard_migrations_pending",
+        "Outbound handoffs awaiting their ack.",
+        fn=lambda: _cluster_stat(system, "migrations_pending"),
+    )
 
 
 def _link_phis(system: Any) -> Optional[Dict[str, float]]:
@@ -485,3 +574,10 @@ def _transit_depth(system: Any) -> Optional[int]:
     fabric = getattr(system, "fabric", None)
     depth = getattr(fabric, "queue_depth", None)
     return depth() if callable(depth) else None
+
+
+def _cluster_stat(system: Any, field: str) -> Optional[float]:
+    cluster = getattr(system, "cluster", None)
+    if cluster is None:
+        return None
+    return cluster.gauge_value(field)

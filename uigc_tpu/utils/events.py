@@ -98,7 +98,38 @@ FRAME_DROPPED = "fabric.frame_dropped"
 FRAME_DUPLICATE = "fabric.frame_duplicate"
 FRAME_GAP = "fabric.frame_gap"
 FRAME_CORRUPT = "fabric.frame_corrupt"
+#: a well-known name lookup could not be resolved by the peer's hello
+#: (fields: address, lookup) — see NodeFabric.lookup (runtime/node.py).
+LOOKUP_MISS = "fabric.lookup_miss"
 UNDO_FOLD = "crgc.undo_fold"
+
+# Cluster-sharding events (ours; uigc_tpu/cluster).  Emitted by the
+# shard regions and the migration machinery so rebalances are observable
+# end to end:
+#   shard.table_update       a new shard table version was adopted
+#                            (fields: version, shards, origin)
+#   shard.migration          one entity handoff completed, measured from
+#                            capture to ack (duration_s; fields: key,
+#                            src, dst, type)
+#   shard.entity_activated   an entity cell was (re)constructed
+#                            (fields: key, type, resumed)
+#   shard.entity_passivated  an idle entity spilled its state and stopped
+#   shard.handoff_buffered   a message was buffered while its entity was
+#                            mid-handoff/passivation (fields: depth)
+#   shard.forwarded          an entity message was re-routed because this
+#                            node no longer owns the key
+#   shard.state_conflict     a migrated snapshot met a resident entity
+#                            that had already processed messages; the
+#                            resident won and the snapshot was dropped
+#                            (the coordinator-free divergence residue —
+#                            counted, never silent)
+SHARD_TABLE = "shard.table_update"
+SHARD_MIGRATION = "shard.migration"
+SHARD_ENTITY_ACTIVATED = "shard.entity_activated"
+SHARD_ENTITY_PASSIVATED = "shard.entity_passivated"
+SHARD_HANDOFF_BUFFERED = "shard.handoff_buffered"
+SHARD_FORWARDED = "shard.forwarded"
+SHARD_STATE_CONFLICT = "shard.state_conflict"
 
 # Telemetry self-observation (uigc_tpu/telemetry):
 #   telemetry.listener_error  a recorder listener raised during dispatch;
