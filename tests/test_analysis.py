@@ -627,16 +627,19 @@ def test_mutation_duplicate_frame_tally(kind):
 
             fabric._deliver_now = mutated
         else:
+            # App frames are delivered in per-cell runs since the
+            # batched transport (runtime/node.py _deliver_app_run) —
+            # inject the duplicate tally at that seam.
             node_fabric = c.fabrics[1]
-            orig_frame = node_fabric._on_frame
+            orig_run = node_fabric._deliver_app_run
 
-            def mutated(from_address, frame):
-                orig_frame(from_address, frame)
-                if not state["duplicated"] and frame[0] == "app":
+            def mutated(from_address, uid, frames):
+                orig_run(from_address, uid, frames)
+                if not state["duplicated"] and frames:
                     state["duplicated"] = True
-                    orig_frame(from_address, frame)
+                    orig_run(from_address, uid, frames)
 
-            node_fabric._on_frame = mutated
+            node_fabric._deliver_app_run = mutated
 
         holder = a.spawn_root(Behaviors.setup_root(lambda ctx: Holder(ctx)), "holder")
         owner = b.spawn_root(

@@ -1,0 +1,323 @@
+"""Fabric bench: two-node link throughput and bulk-teardown timing.
+
+Measures the remote-messaging fast path (runtime/node.py writer
+coalescing + the ``"fb"`` multi-frame wire units) against two baselines
+on ONE localhost TCP pair:
+
+1. **batch**     — frame batching on (the default): per-peer writer
+                   coalesces queued frames into one ``"fb"`` unit per
+                   flush; the receiver runs seq accounting per batch and
+                   delivers app messages in per-cell runs.
+2. **singleton** — ``uigc.node.frame-batching: False`` on both nodes:
+                   same writer thread, but classic one-unit-per-frame
+                   wire format and one flush per frame (what a batching
+                   node sends to a peer that never advertised ``"fb"``).
+3. **inline**    — the reconstructed PRE-WRITER transport: a faithful
+                   copy of the old ``_send_frame`` that pickles the full
+                   frame tuple and runs ``sendall`` while holding the
+                   per-peer sequence lock, monkeypatched over the
+                   NodeFabric of the sending node.  This is the ≥10×
+                   acceptance baseline — the path where dispatcher
+                   workers serialized on ``st.lock`` for the duration of
+                   socket I/O.
+
+Plus a **teardown** phase on a single node: K garbage actors released at
+once, timed from release to full collection (the bulk stop-signal
+cascade: one dispatcher submission per dispatcher, not per actor).
+
+Prints one JSON object; commit as ``BENCH_FABRIC_r{N}.json``.
+
+Usage: python tools/fabric_bench.py [--frames 20000] [--senders 4]
+                                    [--actors 2000] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import pickle
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from uigc_tpu import AbstractBehavior, ActorSystem, Behaviors  # noqa: E402
+from uigc_tpu.runtime.behaviors import RawBehavior  # noqa: E402
+from uigc_tpu.runtime.node import NodeFabric, _frame_bytes  # noqa: E402
+from uigc_tpu.utils import events  # noqa: E402
+from uigc_tpu.utils.validation import require  # noqa: E402
+
+BASE = {
+    "uigc.crgc.wakeup-interval": 25,
+    "uigc.crgc.egress-finalize-interval": 10,
+    "uigc.crgc.shadow-graph": "array",
+    "uigc.crgc.num-nodes": 2,
+}
+
+
+class Sink(RawBehavior):
+    """Counts bench frames; order violations would mean the seq layer
+    let a reordered frame through (it must not)."""
+
+    def __init__(self):
+        self.n = 0
+        self.order_violations = 0
+        self._last = {}
+
+    def on_message(self, msg):
+        if isinstance(msg, tuple) and msg and msg[0] == "n":
+            lane, i = msg[1], msg[2]
+            if i <= self._last.get(lane, -1):
+                self.order_violations += 1
+            self._last[lane] = i
+            self.n += 1
+        return None
+
+
+def _inline_enqueue_job(self, address, st, job):
+    """The pre-writer transport, reconstructed at the job funnel: EVERY
+    frame (app, marker, gossip, heartbeat) runs its egress stamp,
+    sequence claim, fresh-pickler payload encode, full-frame pickle and
+    ``sendall`` synchronously on the calling thread WHILE HOLDING the
+    per-peer lock — so no writer thread ever starts and there is a
+    single seq mutator, exactly the old shape.  Kept only as the
+    measured baseline; the runtime itself no longer contains this
+    pattern (tools/uigc_lint.py UL007 guards against it)."""
+    from uigc_tpu.runtime import wire
+
+    broken = False
+    with st.lock:
+        inner = self._job_inner(job)
+        if inner is None:
+            return
+        if inner[0] == "app" and not isinstance(inner[2], bytes):
+            # Fresh pickler per message, like the pre-pool wire codec.
+            buf = io.BytesIO()
+            wire._Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(inner[2])
+            inner = (inner[0], inner[1], buf.getvalue()) + tuple(inner[3:])
+        transmit = []
+        self._apply_verdict(st, address, inner, inner[0], self.fault_plan, transmit)
+        conn = self._conn_for(address)
+        if conn is None:
+            return
+        for seq, frame, trunc in transmit:
+            try:
+                conn.send_bytes(_frame_bytes(("f", seq, frame), trunc))  # uigc-lint: disable=UL007
+            except OSError:
+                broken = True
+                break
+    if broken:
+        self._on_conn_broken(address, conn)
+
+
+class Pair:
+    def __init__(self, name, batching, inline=False):
+        cfg = dict(BASE)
+        if not batching:
+            cfg["uigc.node.frame-batching"] = False
+        self.fa = NodeFabric()
+        self.fb = NodeFabric()
+        self.a = ActorSystem(None, name=f"{name}-a", config=cfg, fabric=self.fa)
+        self.b = ActorSystem(None, name=f"{name}-b", config=cfg, fabric=self.fb)
+        self.sink = Sink()
+        sink_cell = self.b.spawn_system_raw(self.sink, "sink")
+        self.fb.register_name("sink", sink_cell)
+        port = self.fb.listen()
+        if inline:
+            # Patch ONLY the sending fabric's job funnel: the receive
+            # side is the same singleton path either way.
+            self.fa._enqueue_job = _inline_enqueue_job.__get__(self.fa)
+        addr_b = self.fa.connect("127.0.0.1", port)
+        self.proxy = self.fa.lookup(addr_b, "sink")
+
+    def close(self):
+        for system in (self.a, self.b):
+            try:
+                system.terminate(timeout_s=5.0)
+            except Exception:
+                pass
+
+
+def run_link_mode(mode: str, n_frames: int, n_senders: int) -> dict:
+    pair = Pair(
+        f"fbb-{mode}",
+        batching=(mode == "batch"),
+        inline=(mode == "inline"),
+    )
+    batch_sizes = []
+
+    def listener(name, fields):
+        if name == events.FRAME_BATCH:
+            batch_sizes.append(fields.get("size", 0))
+
+    events.recorder.enable()
+    events.recorder.add_listener(listener)
+    try:
+        per_sender = n_frames // n_senders
+        total = per_sender * n_senders
+        proxy = pair.proxy
+
+        def sender(lane):
+            for i in range(per_sender):
+                proxy.tell(("n", lane, i))
+
+        threads = [
+            threading.Thread(target=sender, args=(lane,)) for lane in range(n_senders)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # Generous drain window: the inline baseline convoys down to a
+        # few hundred frames/s on a bad run — that slowness is the
+        # measurement, not a failure.
+        deadline = time.monotonic() + 300
+        while pair.sink.n < total and time.monotonic() < deadline:
+            time.sleep(0.005)
+        dt = time.perf_counter() - t0
+        require(
+            pair.sink.n == total,
+            "fabric_bench.delivery",
+            "not every bench frame was delivered",
+            mode=mode,
+            received=pair.sink.n,
+            expected=total,
+        )
+        require(
+            pair.sink.order_violations == 0,
+            "fabric_bench.order",
+            "the seq layer let a reordered frame through",
+            mode=mode,
+        )
+        out = {
+            "frames": total,
+            "senders": n_senders,
+            "seconds": dt,
+            "frames_per_sec": total / dt,
+        }
+        if mode == "batch":
+            out["writer_flushes"] = len(batch_sizes)
+            out["mean_batch_size"] = (
+                sum(batch_sizes) / len(batch_sizes) if batch_sizes else 0.0
+            )
+            out["max_batch_size"] = max(batch_sizes) if batch_sizes else 0
+        return out
+    finally:
+        events.recorder.remove_listener(listener)
+        events.recorder.disable()
+        events.recorder.reset()
+        pair.close()
+
+
+class _Child(AbstractBehavior):
+    def on_message(self, msg):
+        return self
+
+    def on_signal(self, signal):
+        return None
+
+
+class _Spawner(AbstractBehavior):
+    """Root that spawns K children and releases them all on ("drop",)."""
+
+    def __init__(self, context, k):
+        super().__init__(context)
+        self.children = [
+            context.spawn(Behaviors.setup(lambda ctx: _Child(ctx)), f"c{i}")
+            for i in range(k)
+        ]
+
+    def on_message(self, msg):
+        if msg == ("drop",):
+            self.context.release(*self.children)
+            self.children = []
+        return self
+
+    def on_signal(self, signal):
+        return None
+
+
+def run_teardown(n_actors: int) -> dict:
+    cfg = {
+        "uigc.crgc.wakeup-interval": 10,
+        "uigc.crgc.shadow-graph": "array",
+    }
+    system = ActorSystem(None, name="fbb-teardown", config=cfg)
+    try:
+        root = system.spawn_root(
+            Behaviors.setup_root(lambda ctx: _Spawner(ctx, n_actors)), "spawner"
+        )
+        deadline = time.monotonic() + 60
+        while (
+            system.live_actor_count < n_actors + 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        base = system.live_actor_count - n_actors
+        t0 = time.perf_counter()
+        root.tell(("drop",))
+        while system.live_actor_count > base and time.monotonic() < deadline:
+            time.sleep(0.002)
+        dt = time.perf_counter() - t0
+        collected = n_actors - max(0, system.live_actor_count - base)
+        require(
+            collected == n_actors,
+            "fabric_bench.teardown",
+            "released actors were not all collected",
+            collected=collected,
+            expected=n_actors,
+        )
+        return {
+            "actors": n_actors,
+            "seconds": dt,
+            "actors_per_sec": n_actors / dt,
+        }
+    finally:
+        try:
+            system.terminate(timeout_s=5.0)
+        except Exception:
+            pass
+
+
+def run(n_frames: int, n_senders: int, n_actors: int) -> dict:
+    result = {"frames": n_frames, "senders": n_senders}
+    result["link"] = {
+        mode: run_link_mode(mode, n_frames, n_senders)
+        for mode in ("inline", "singleton", "batch")
+    }
+    link = result["link"]
+    result["speedup_vs_inline"] = (
+        link["batch"]["frames_per_sec"] / link["inline"]["frames_per_sec"]
+    )
+    result["speedup_vs_singleton"] = (
+        link["batch"]["frames_per_sec"] / link["singleton"]["frames_per_sec"]
+    )
+    result["teardown"] = run_teardown(n_actors)
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--frames", type=int, default=20000)
+    parser.add_argument("--senders", type=int, default=4)
+    parser.add_argument("--actors", type=int, default=2000)
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="quick correctness pass (2k frames, 200 actors); asserts "
+        "delivery, ordering and full teardown, not the speedup floor",
+    )
+    args = parser.parse_args()
+    if args.smoke:
+        args.frames, args.actors = 2000, 200
+    result = run(args.frames, args.senders, args.actors)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,19 @@ UL006  direct-proxycell-construction
     through ``EntityRef`` (uigc_tpu/cluster); transport-level code that
     really needs a proxy goes through ``fabric._proxy``.
 
+UL007  socket-io-under-peer-lock
+    A blocking socket call (``sendall``/``send_bytes``/``recv``/
+    ``accept``/``connect``/``create_connection``) lexically inside a
+    ``with`` block holding a ``_PeerState`` lock (``st.lock`` /
+    ``st.rlock``, or any ``.lock``/``.rlock`` on a name bound from
+    ``_peer_state(...)``).  This is the transport convoy the writer
+    refactor removed: every dispatcher worker sending to that peer
+    serializes on the lock for the DURATION of socket I/O, so one slow
+    link stalls the whole mutator plane.  Sequence claims and fault
+    verdicts belong under the lock; encoding and socket writes belong
+    on the per-peer writer thread, off-lock.  Grandfathered nowhere —
+    new occurrences always fail ``--strict``.
+
 Suppression
 ===========
 
@@ -90,6 +103,18 @@ RULES = {
     "UL004": "bare assert used for a runtime invariant in library code",
     "UL005": "inconsistent lock-acquisition order",
     "UL006": "direct ProxyCell construction outside runtime/",
+    "UL007": "blocking socket call while holding a _PeerState lock",
+}
+
+#: method names that hit the network (or block on it) — the UL007 set.
+_SOCKET_CALLS = {
+    "sendall",
+    "send_bytes",
+    "recv",
+    "accept",
+    "connect",
+    "create_connection",
+    "makefile",
 }
 
 _REF_NAME = re.compile(r"(^|_)refs?($|_)|refob", re.IGNORECASE)
@@ -201,9 +226,63 @@ class _FileLinter:
                 self._lint_class(node)
             elif isinstance(node, ast.Call) and not in_runtime:
                 self._lint_proxycell(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._lint_socket_under_peer_lock(node)
         if lint_asserts:
             self._lint_asserts()
         self._collect_lock_pairs()
+
+    def _lint_socket_under_peer_lock(self, fn: ast.AST) -> None:
+        """UL007: blocking socket I/O under a _PeerState lock.
+
+        A 'peer lock' is approximated as ``<name>.lock`` / ``<name>.rlock``
+        where ``<name>`` is the conventional ``st`` or was assigned from a
+        ``_peer_state(...)`` call in the same function — the exact shape
+        the pre-writer transport used (sendall under ``st.lock``)."""
+        peer_vars = {"st"}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _call_name(node.value)[1] == "_peer_state":
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            peer_vars.add(target.id)
+
+        def holds_peer_lock(with_node: ast.With) -> bool:
+            for item in with_node.items:
+                expr = item.context_expr
+                if (
+                    isinstance(expr, ast.Attribute)
+                    and expr.attr in ("lock", "rlock")
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id in peer_vars
+                ):
+                    return True
+            return False
+
+        def walk(node: ast.AST, held: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # A nested def's body runs later, not under the
+                    # lock — and the outer ast.walk dispatch will lint
+                    # it as its own function, so don't descend here
+                    # (that would double-report its violations).
+                    continue
+                if held and isinstance(child, ast.Call):
+                    name = _call_name(child)[1]
+                    if name in _SOCKET_CALLS:
+                        self.add(
+                            child.lineno,
+                            "UL007",
+                            f"blocking socket call {name}() while holding a "
+                            "_PeerState lock; claim the seq under the lock, "
+                            "write on the peer's writer thread",
+                        )
+                if isinstance(child, ast.With):
+                    walk(child, held or holds_peer_lock(child))
+                else:
+                    walk(child, held)
+
+        walk(fn, False)
 
     def _lint_proxycell(self, call: ast.Call) -> None:
         """UL006: ProxyCell must come from the fabric's cache (or, for

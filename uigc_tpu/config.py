@@ -96,6 +96,22 @@ DEFAULTS: Dict[str, Any] = {
     # Milliseconds of backoff before the first reconnect attempt,
     # doubled per attempt.
     "uigc.node.reconnect-backoff": 50,
+    # Multi-frame batch units on peer links: every frame queued for one
+    # peer is coalesced by its writer thread into a single "fb" wire
+    # unit flushed in one sendall.  The capability is negotiated in the
+    # hello tuple, so a batching node automatically sends classic
+    # singleton units to peers that never advertised it.  Off, this
+    # node neither advertises nor emits batches (the mixed-version
+    # interop mode; frames still ride the writer thread, one flush per
+    # frame).
+    "uigc.node.frame-batching": True,
+    # Per-peer writer queue high-water mark, in frames; senders to a
+    # peer whose writer cannot keep up block briefly at this depth
+    # (backpressure) instead of growing the queue unboundedly.
+    "uigc.node.writer-queue-limit": 8192,
+    # Maximum frames coalesced into one batch flush (bounds worst-case
+    # batch latency and the receiver's per-unit work).
+    "uigc.node.max-batch-frames": 256,
     # --- Cluster sharding (uigc_tpu/cluster; no reference analogue —
     # the reference stops at GC middleware, this is the serving layer
     # above it) ---

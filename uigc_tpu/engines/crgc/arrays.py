@@ -980,10 +980,8 @@ class ArrayShadowGraph:
                     kill = np.concatenate([kill, pad])
                 garbage_slots = np.nonzero(garbage)[0]
                 kill_slots = np.nonzero(kill)[0]
-                if should_kill:
-                    cells = self.cells
-                    for slot in kill_slots.tolist():
-                        cells[slot].tell(StopMsg)
+                if should_kill and kill_slots.size:
+                    self._kill_slots_bulk(kill_slots)
                 if garbage_slots.size:
                     self._free_slots_batch(garbage, garbage_slots)
             ev.fields["num_garbage_actors"] = int(garbage_slots.size)
@@ -1010,10 +1008,8 @@ class ArrayShadowGraph:
                 garbage_slots = np.nonzero(garbage)[0]
                 kill_slots = np.nonzero(kill)[0]
 
-                if should_kill:
-                    cells = self.cells
-                    for slot in kill_slots.tolist():
-                        cells[slot].tell(StopMsg)
+                if should_kill and kill_slots.size:
+                    self._kill_slots_bulk(kill_slots)
 
                 if garbage_slots.size:
                     self._free_slots_batch(garbage, garbage_slots)
@@ -1021,6 +1017,16 @@ class ArrayShadowGraph:
             ev.fields["num_garbage_actors"] = int(garbage_slots.size)
             ev.fields["num_live_actors"] = int(np.count_nonzero(mark))
         return int(garbage_slots.size)
+
+    def _kill_slots_bulk(self, kill_slots: np.ndarray) -> None:
+        """Send StopMsg to every kill slot's cell as ONE bulk teardown:
+        the finalize cascade is batched per dispatcher (and, for remote
+        cells, per peer writer), so a wake that kills K actors costs
+        O(batches) dispatcher operations, not O(K)."""
+        from ...runtime.cell import tell_bulk
+
+        cells = self.cells
+        tell_bulk((cells[slot], StopMsg) for slot in kill_slots.tolist())
 
     def _free_slots_batch(
         self, garbage: np.ndarray, garbage_slots: np.ndarray

@@ -31,6 +31,7 @@ two levels the reference collapses into one).
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import Dict, List, Optional, Tuple
 
@@ -44,6 +45,35 @@ from .arrays import ArrayShadowGraph
 from .state import CrgcContext
 
 _SINK_PAD = 64  # scatter batches are padded to multiples of this
+
+#: Serializes sharded-collective dispatch + readback across EVERY
+#: MeshShadowGraph in the process.  The virtual CPU mesh (and a real
+#: slice) is ONE set of devices; two collector threads concurrently
+#: executing all_gather-bearing programs on it can deadlock each other
+#: (observed as permanently wedged Bookkeeper threads when several
+#: mesh-backend systems coexist in one test process — each program
+#: waits for all devices, and the runtime interleaves the two
+#: collectives).  Per-wake serialization costs nothing in the
+#: steady state — one collector per process is the deployment shape —
+#: and makes multi-system processes (the test suite) hang-free.
+#: Only the collective-bearing programs (the sharded trace and the
+#: decremental wake) need the lock; _sync_device's scatters and folds
+#: are per-shard local work with no rendezvous, so they run outside it.
+#: Reentrant: the synchronous decremental path dispatches AND reads
+#: back under one compute_marks hold.
+_MESH_COLLECTIVE_LOCK = threading.RLock()
+
+#: Traced collective programs shared across graphs: every system in a
+#: process meshes the same devices, so graphs with identical geometry
+#: reuse ONE jit object (and therefore one XLA compilation — first
+#: caller compiles under the collective lock, the rest hit the cache
+#: instead of serializing ~seconds of duplicate compile work behind it).
+#: Bounded: cleared wholesale at the cap (a growing graph re-keys as its
+#: padding doubles; without a cap a long-lived process would accumulate
+#: one compiled program per geometry ever seen).  A clear only costs a
+#: recompile on the next wake of each live geometry.
+_SHARED_PROGRAM_CACHE: Dict[tuple, object] = {}
+_SHARED_PROGRAM_CACHE_MAX = 32
 
 
 def _pow2(x: int) -> int:
@@ -122,7 +152,6 @@ class MeshShadowGraph(ArrayShadowGraph):
         self._pending_fresh_dst: set = set()
 
         self._jit_cache: Dict[str, object] = {}
-        self._trace_cache: Dict[tuple, object] = {}
 
     @property
     def can_pipeline(self) -> bool:
@@ -143,8 +172,35 @@ class MeshShadowGraph(ArrayShadowGraph):
         with events.recorder.timed(events.DEVICE_TRACE):
             self._sync_device()
             self.stats["wakes"] += 1
-            out = self._dispatch_decremental_wake(self._layout_meta)
+            with _MESH_COLLECTIVE_LOCK:
+                out = self._dispatch_decremental_wake(self._layout_meta)
         return _MeshWakeHandle(self), out[0]
+
+    def _shared_program(self, tag: str, meta, factory):
+        """Process-wide cache of the traced collective programs, keyed
+        by the full geometry (graphs with equal shapes share one jit
+        object and one compilation)."""
+        key = (
+            tag,
+            self._n_pad,
+            self._shard_size,
+            meta["n_blocks"],
+            meta["r_rows"],
+            self.s_rows,
+            self._bucket_m,
+            meta["sub"],
+            meta["group"],
+            tuple(d.id for d in self.mesh.devices.flat),
+            self.mesh.axis_names,
+        )
+        fn = _SHARED_PROGRAM_CACHE.get(key)
+        if fn is None:
+            if len(_SHARED_PROGRAM_CACHE) >= _SHARED_PROGRAM_CACHE_MAX:
+                _SHARED_PROGRAM_CACHE.clear()
+            # setdefault: a build race costs one discarded closure, never
+            # a duplicate compile (compilation happens at first call).
+            fn = _SHARED_PROGRAM_CACHE.setdefault(key, factory())
+        return fn
 
     # ------------------------------------------------------------- #
     # Device state construction
@@ -466,11 +522,14 @@ class MeshShadowGraph(ArrayShadowGraph):
             self.stats["wakes"] += 1
             meta = self._layout_meta
             if self.decremental:
-                return self._compute_marks_decremental(meta)
-            key = (self._n_pad, meta["n_blocks"], self._bucket_m)
-            traced = self._trace_cache.get(key)
-            if traced is None:
-                traced = sharded_trace.make_sharded_pallas_trace(
+                # One hold spans dispatch AND readback: exactly one
+                # collective program is in flight at a time.
+                with _MESH_COLLECTIVE_LOCK:
+                    return self._compute_marks_decremental(meta)
+            traced = self._shared_program(
+                "trace",
+                meta,
+                lambda: sharded_trace.make_sharded_pallas_trace(
                     self.mesh,
                     self._n_pad,
                     self._shard_size,
@@ -480,19 +539,20 @@ class MeshShadowGraph(ArrayShadowGraph):
                     self._bucket_m,
                     sub=meta["sub"],
                     group=meta["group"],
-                )
-                self._trace_cache[key] = traced
-            mark = traced(
-                self._dev_flags,
-                self._dev_recv,
-                self._dev_stacked["bmeta1"],
-                self._dev_stacked["bmeta2"],
-                self._dev_stacked["row_pos"],
-                self._dev_stacked["emeta"],
-                self._dev_psrc,
-                self._dev_pdst,
+                ),
             )
-            return np.asarray(mark)[: self.capacity]
+            with _MESH_COLLECTIVE_LOCK:
+                mark = traced(
+                    self._dev_flags,
+                    self._dev_recv,
+                    self._dev_stacked["bmeta1"],
+                    self._dev_stacked["bmeta2"],
+                    self._dev_stacked["row_pos"],
+                    self._dev_stacked["emeta"],
+                    self._dev_psrc,
+                    self._dev_pdst,
+                )
+                return np.asarray(mark)[: self.capacity]
 
     def _dispatch_decremental_wake(self, meta) -> tuple:
         """Dispatch one closure+repair wake on the mesh (regional
@@ -504,10 +564,10 @@ class MeshShadowGraph(ArrayShadowGraph):
         instead of feeding poisoned arrays forever."""
         import jax
 
-        key = ("dec", self._n_pad, meta["n_blocks"], self._bucket_m)
-        wake = self._trace_cache.get(key)
-        if wake is None:
-            wake = sharded_trace.make_sharded_decremental_wake(
+        wake = self._shared_program(
+            "dec",
+            meta,
+            lambda: sharded_trace.make_sharded_decremental_wake(
                 self.mesh,
                 self._n_pad,
                 self._shard_size,
@@ -517,8 +577,8 @@ class MeshShadowGraph(ArrayShadowGraph):
                 self._bucket_m,
                 sub=meta["sub"],
                 group=meta["group"],
-            )
-            self._trace_cache[key] = wake
+            ),
+        )
         if self._wake_state is None:
             nodes_s, _, _ = self._sharding()
             z = jax.device_put(
@@ -579,7 +639,11 @@ class _MeshWakeHandle:
 
     def unpack_marks(self, mark_dev) -> np.ndarray:
         try:
-            return np.asarray(mark_dev)[: self.n]
+            # Readback waits for the in-flight collective; take the
+            # process-wide mesh lock so it cannot interleave with
+            # another graph's dispatch (see _MESH_COLLECTIVE_LOCK).
+            with _MESH_COLLECTIVE_LOCK:
+                return np.asarray(mark_dev)[: self.n]
         except Exception:
             self.graph.invalidate_wake_state()
             raise

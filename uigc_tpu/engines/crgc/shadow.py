@@ -10,7 +10,7 @@ entry streams — the same technique the reference author used
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, List, Optional
+from typing import TYPE_CHECKING, Any, Dict, List, Optional
 
 from ...utils import events
 from .messages import StopMsg, WaveMsg
@@ -257,6 +257,7 @@ class ShadowGraph:
             # The sweep in its own timed event, for the wake profiler's
             # trace-vs-sweep attribution (telemetry/profile.py).
             with events.recorder.timed(events.SWEEP):
+                kills: List[Any] = []
                 for shadow in self.from_set:
                     if shadow.mark != marked:
                         num_garbage += 1
@@ -268,9 +269,16 @@ class ShadowGraph:
                             and shadow.supervisor is not None
                             and shadow.supervisor.mark == marked
                         ):
-                            shadow.self_cell.tell(StopMsg)
+                            kills.append(shadow.self_cell)
                     else:
                         num_live += 1
+                if kills:
+                    # Bulk teardown: one dispatcher submission per
+                    # dispatcher for the whole kill set, not one per
+                    # actor (runtime/cell.py tell_bulk).
+                    from ...runtime.cell import tell_bulk
+
+                    tell_bulk((cell, StopMsg) for cell in kills)
 
                 self.from_set = to_set
                 self.marked = not marked

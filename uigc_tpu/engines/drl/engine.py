@@ -371,5 +371,16 @@ class DRL(Engine):
                     ref, already_released=ref not in state.active_refs
                 )
         targets = state.release(releasing)
-        for target_cell, (released, created) in targets.items():
-            target_cell.tell(ReleaseMsg(released, created))
+        if len(targets) > 1:
+            # Bulk release: one dispatcher submission per dispatcher for
+            # the whole target set (runtime/cell.py tell_bulk), so a
+            # wide release fan-out costs O(batches), not O(targets).
+            from ...runtime.cell import tell_bulk
+
+            tell_bulk(
+                (target_cell, ReleaseMsg(released, created))
+                for target_cell, (released, created) in targets.items()
+            )
+        else:
+            for target_cell, (released, created) in targets.items():
+                target_cell.tell(ReleaseMsg(released, created))

@@ -366,6 +366,7 @@ class MAC(Engine):
     ) -> None:
         """(reference: MAC.scala:268-288)"""
         tap = self.tap
+        dec_sends = []
         for ref in releasing:
             if tap is not None:
                 tap.on_release(
@@ -380,10 +381,19 @@ class MAC(Engine):
             else:
                 pair = state.actor_map[ref.target]
                 if pair.num_refs <= 1:
-                    ref.target.tell(DecMsg(pair.weight))
+                    dec_sends.append((ref.target, DecMsg(pair.weight)))
                     del state.actor_map[ref.target]
                 else:
                     pair.num_refs -= 1
+        if len(dec_sends) > 1:
+            # Bulk decrement fan-out: one dispatcher submission per
+            # dispatcher for the whole release set (runtime/cell.py).
+            from ...runtime.cell import tell_bulk
+
+            tell_bulk(dec_sends)
+        else:
+            for target_cell, dec in dec_sends:
+                target_cell.tell(dec)
 
     # -- Shutdown ------------------------------------------------------ #
 

@@ -265,9 +265,14 @@ class NativeShadowGraph:
             # in its own timed event for the wake profiler's
             # trace-vs-sweep attribution (telemetry/profile.py).
             with events.recorder.timed(events.SWEEP):
-                if should_kill:
-                    for aid in kill_ids[: n_kill.value]:
-                        self._cell_of_id[int(aid)].tell(StopMsg)
+                if should_kill and n_kill.value:
+                    from ..runtime.cell import tell_bulk
+
+                    cell_of_id = self._cell_of_id
+                    tell_bulk(
+                        (cell_of_id[int(aid)], StopMsg)
+                        for aid in kill_ids[: n_kill.value]
+                    )
                 for aid in garbage_ids[:n_garbage]:
                     cell = self._cell_of_id.pop(int(aid), None)
                     if cell is not None:

@@ -160,6 +160,40 @@ class ActorCell:
         if dispatch:
             self._dispatcher.execute(self._process_batch)
 
+    def tell_batch(self, msgs: List[Any]) -> None:
+        """Enqueue a RUN of application messages with one lock
+        acquisition and at most one dispatcher submission — the receive
+        half of frame batching (runtime/node.py delivers a burst of
+        remote messages to one cell as a single run, so a K-message
+        burst schedules one dispatcher batch instead of K)."""
+        if not msgs:
+            return
+        dead = None
+        dispatch = False
+        with self._lock:
+            if self._lifecycle != _ACTIVE:
+                dead = msgs
+            else:
+                self._mailbox.extend(msgs)
+                self._last_active = time.monotonic()
+                dispatch = self._mark_scheduled()
+        if dead is not None:
+            for msg in dead:
+                self.system.record_dead_letter(self, msg)
+            return
+        if self.system.sched_events and events.recorder.enabled:
+            tid = threading.get_ident()
+            for _ in msgs:
+                events.recorder.commit(
+                    events.SCHED_ENQUEUE,
+                    cell=self.uid,
+                    path=self.path,
+                    kind="app",
+                    thread=tid,
+                )
+        if dispatch:
+            self._dispatcher.execute(self._process_batch)
+
     def tell_system(self, msg: Any) -> None:
         with self._lock:
             if self._lifecycle == _TERMINATED:
@@ -430,8 +464,17 @@ class ActorCell:
             return
         self._lifecycle = _STOPPING
         if self.children:
-            for child in list(self.children.values()):
-                child.tell_system(_SYS_STOP)
+            children = list(self.children.values())
+            if len(children) == 1:
+                children[0].tell_system(_SYS_STOP)
+            else:
+                # Bulk cascade: one dispatcher submission per dispatcher
+                # instead of one per child, so stopping a wide subtree
+                # costs O(dispatchers), not O(children), in scheduling.
+                tell_bulk(
+                    ((child, _SYS_STOP) for child in children),
+                    system_channel=True,
+                )
         else:
             self._finalize()
 
@@ -540,3 +583,81 @@ class ActorCell:
 
     def __repr__(self) -> str:
         return f"ActorCell({self.path}#{self.uid})"
+
+
+def tell_bulk(pairs, system_channel: bool = False) -> int:
+    """Deliver many (cell, message) pairs with dispatcher-level
+    coalescing: every cell newly claimed for scheduling is grouped by
+    its dispatcher, and each dispatcher receives ONE runnable that
+    processes all of its claimed cells back to back.
+
+    This is the propagation-blocking idea applied to teardown and
+    release cascades: when a collector wake kills K actors (or an actor
+    releases refs to K targets), the per-unit ``tell`` path would
+    enqueue K separate dispatcher work items — GIL-serialized scheduling
+    overhead proportional to the kill set.  Binning per destination
+    dispatcher makes the cascade cost O(dispatchers + messages) instead
+    of O(actors) dispatch operations.
+
+    ``system_channel=True`` routes messages to the system mailbox (the
+    stop-protocol channel).  Targets without a local mailbox (remote
+    proxies) fall back to plain ``tell`` — their batching happens on the
+    transport's per-peer writer instead.  Returns the number of
+    dispatcher submissions made."""
+    by_dispatcher: Dict[int, tuple] = {}
+    dead: List[tuple] = []
+    delivered: List[tuple] = []
+    for cell, msg in pairs:
+        lock = getattr(cell, "_lock", None)
+        if lock is None:  # remote/proxy handle
+            cell.tell(msg)
+            continue
+        with lock:
+            if system_channel:
+                if cell._lifecycle == _TERMINATED:
+                    continue
+                cell._sysbox.append(msg)
+                claimed = cell._mark_scheduled()
+            else:
+                if cell._lifecycle != _ACTIVE:
+                    dead.append((cell, msg))
+                    continue
+                cell._mailbox.append(msg)
+                cell._last_active = time.monotonic()
+                claimed = cell._mark_scheduled()
+        delivered.append((cell, msg))
+        if claimed:
+            entry = by_dispatcher.get(id(cell._dispatcher))
+            if entry is None:
+                entry = by_dispatcher[id(cell._dispatcher)] = (
+                    cell._dispatcher,
+                    [],
+                )
+            entry[1].append(cell)
+    for cell, msg in dead:
+        cell.system.record_dead_letter(cell, msg)
+    if delivered and events.recorder.enabled:
+        kind = "sys" if system_channel else "app"
+        tid = threading.get_ident()
+        for cell, _msg in delivered:
+            if cell.system.sched_events:
+                events.recorder.commit(
+                    events.SCHED_ENQUEUE,
+                    cell=cell.uid,
+                    path=cell.path,
+                    kind=kind,
+                    thread=tid,
+                )
+    submissions = 0
+    for dispatcher, cells in by_dispatcher.values():
+        submissions += 1
+        if len(cells) == 1:
+            dispatcher.execute(cells[0]._process_batch)
+        else:
+
+            def _run_claimed(batch=tuple(cells)):
+                for claimed_cell in batch:
+                    claimed_cell._process_batch()
+
+            dispatcher.execute(_run_claimed)
+    return submissions

@@ -166,11 +166,17 @@ class TimerService:
                     return
                 now = time.monotonic()
                 if not self._heap:
-                    self._cond.wait(timeout=0.5)
+                    # Idle: sleep until something is scheduled (or
+                    # shutdown) — no heartbeat polling, so an idle
+                    # system burns zero timer wakeups.  _schedule and
+                    # shutdown both notify under the condition.
+                    self._cond.wait()
                     continue
                 when, _, key, fn, repeat_s = self._heap[0]
                 if when > now:
-                    self._cond.wait(timeout=min(when - now, 0.5))
+                    # Sleep exactly until the head's deadline; an
+                    # earlier schedule_* notifies and re-evaluates.
+                    self._cond.wait(timeout=when - now)
                     continue
                 heapq.heappop(self._heap)
                 cancelled = self._cancelled.get(key, True)

@@ -231,29 +231,10 @@ class Fabric:
             self._deliver_now(link, target, payload)
 
     def _deliver_now(self, link: Link, target: "ActorCell", payload: Any) -> None:
-        msg = (
-            wire.decode_message(self, payload) if self.serialize else payload
-        )
-        if link.drop_filter is not None and link.drop_filter(msg):
-            return
-        if self.fault_plan is not None and self.fault_plan.drop_inbound(
-            link.src.address, link.dst.address, msg
-        ):
-            events.recorder.commit(
-                events.FRAME_DROPPED,
-                src=link.src.address,
-                dst=link.dst.address,
-                kind="app",
-            )
-            return
-        if link.dst.address in self.crashed:
-            return
-        with link.recv_lock:
-            if link.ingress is not None:
-                link.ingress.on_message(target, msg)
-            # tell under recv_lock keeps mailbox order consistent with
-            # the ingress tally order (per-link FIFO all the way down).
-            target.tell(msg)
+        # One admission edge for both shapes: a single message is a
+        # run of one (decode, drop filters, crashed gate, ingress tally
+        # + enqueue under recv_lock all live in _deliver_run).
+        self._deliver_run(link, target, [payload])
 
     def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
         """Ask the egress of link (src -> dst) to close its window and
@@ -337,27 +318,104 @@ class Fabric:
                 while not self._queue:
                     self._idle.set()
                     self._cv.wait()
-                item = self._queue.popleft()
-            kind, link = item[0], item[1]
+                # Batch-pop: one condition round-trip per burst instead
+                # of per item (the transit queue's analogue of the node
+                # transport's writer coalescing).
+                items = []
+                for _ in range(min(len(self._queue), 256)):
+                    items.append(self._queue.popleft())
+            i = 0
+            n = len(items)
+            while i < n:
+                item = items[i]
+                kind, link = item[0], item[1]
+                try:
+                    if kind == "msg":
+                        # Coalesce the run of consecutive messages bound
+                        # for the same cell over the same link: one
+                        # decode/filter pass each, then a single
+                        # recv_lock hold + tell_batch, so a burst
+                        # schedules one dispatcher batch instead of N.
+                        target = item[2]
+                        j = i + 1
+                        while (
+                            j < n
+                            and items[j][0] == "msg"
+                            and items[j][1] is link
+                            and items[j][2] is target
+                        ):
+                            j += 1
+                        if j - i == 1:
+                            self._deliver_now(link, target, item[3])
+                        else:
+                            self._deliver_run(
+                                link, target, [it[3] for it in items[i:j]]
+                            )
+                        i = j
+                        continue
+                    elif kind == "marker":
+                        with link.recv_lock:
+                            link.ingress.finalize_window(item[2])
+                    else:  # "final"
+                        with link.recv_lock:
+                            link.ingress.finalize_all(is_final=True)
+                        events.recorder.commit(
+                            events.DEAD_LINK_FINALIZED,
+                            src=link.src.address,
+                            dst=link.dst.address,
+                        )
+                except Exception:  # pragma: no cover - keep the lane alive
+                    import traceback
+
+                    traceback.print_exc()
+                i += 1
+
+    def _deliver_run(self, link: Link, target: "ActorCell", payloads: list) -> None:
+        """The run-delivery half of the batched drain: decode and filter
+        each payload (same admission edge as _deliver_now), then tally
+        and enqueue the survivors under one recv_lock hold."""
+        msgs = []
+        for payload in payloads:
             try:
-                if kind == "msg":
-                    _, _, target, payload = item
-                    self._deliver_now(link, target, payload)
-                elif kind == "marker":
-                    with link.recv_lock:
-                        link.ingress.finalize_window(item[2])
-                else:  # "final"
-                    with link.recv_lock:
-                        link.ingress.finalize_all(is_final=True)
-                    events.recorder.commit(
-                        events.DEAD_LINK_FINALIZED,
-                        src=link.src.address,
-                        dst=link.dst.address,
-                    )
-            except Exception:  # pragma: no cover - keep the lane alive
+                msg = (
+                    wire.decode_message(self, payload)
+                    if self.serialize
+                    else payload
+                )
+            except Exception:
+                # One undecodable payload must not void the rest of the
+                # run (the per-item path lost exactly one message too).
                 import traceback
 
                 traceback.print_exc()
+                continue
+            if link.drop_filter is not None and link.drop_filter(msg):
+                continue
+            if self.fault_plan is not None and self.fault_plan.drop_inbound(
+                link.src.address, link.dst.address, msg
+            ):
+                events.recorder.commit(
+                    events.FRAME_DROPPED,
+                    src=link.src.address,
+                    dst=link.dst.address,
+                    kind="app",
+                )
+                continue
+            msgs.append(msg)
+        if not msgs or link.dst.address in self.crashed:
+            return
+        with link.recv_lock:
+            if link.ingress is not None:
+                for msg in msgs:
+                    link.ingress.on_message(target, msg)
+            # enqueue under recv_lock keeps mailbox order consistent
+            # with the ingress tally order (per-link FIFO all the way
+            # down).
+            if hasattr(target, "tell_batch"):
+                target.tell_batch(msgs)
+            else:
+                for msg in msgs:
+                    target.tell(msg)
 
     def flush(self, timeout_s: float = 10.0) -> bool:
         """Wait until the transit queue is drained (tests)."""

@@ -7,7 +7,11 @@ in-process ``Fabric`` (runtime/fabric.py) at its message admission edge —
 generalizing the ad-hoc per-link drop filters into something a chaos test
 or ``tools/chaos_bench.py`` can construct once and replay exactly.
 
-Semantics at the sender edge (NodeFabric frames):
+Semantics at the sender edge (NodeFabric frames; since the writer-thread
+transport, verdicts run on the destination peer's writer in STREAM order
+— the order frames were queued, which is the order they would hit the
+wire — so batching changes neither which frame a rule matches nor the
+receiver-observable outcome):
 
 - ``drop``      the frame is never transmitted but *consumes* a sequence
                 number, so the receiver observes a gap (the wire analogue

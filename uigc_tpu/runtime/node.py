@@ -61,6 +61,7 @@ import struct
 import threading
 import time
 import traceback
+from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils import events
@@ -168,8 +169,9 @@ class _PeerState:
     """Per-peer transport state that must survive reconnects: sequence
     counters (a fresh socket continues the old stream's numbering, which
     is what lets the receiver discard retransmitted duplicates and
-    *detect* lost frames as gaps), fault-injection hold queues, and the
-    dial info used to re-establish a torn link."""
+    *detect* lost frames as gaps), fault-injection hold queues, the
+    outbound writer queue, and the dial info used to re-establish a torn
+    link."""
 
     __slots__ = (
         "lock",
@@ -185,10 +187,17 @@ class _PeerState:
         "reconnecting",
         "pending_break",
         "nonce",
+        "outq",
+        "out_ev",
+        "out_cv",
+        "writer",
+        "caps",
     )
 
     def __init__(self) -> None:
-        #: serializes seq assignment + socket writes (sender side)
+        #: serializes seq assignment + outbound-queue admission (sender
+        #: side).  Socket writes happen on the peer's writer thread,
+        #: OFF this lock — a sender never blocks on socket I/O.
         self.lock = threading.Lock()
         #: serializes seq acceptance (receiver side; separate from the
         #: send lock so socket backpressure on the outbound half can
@@ -209,6 +218,23 @@ class _PeerState:
         self.pending_break: Optional["_Conn"] = None
         #: the peer incarnation this stream state belongs to
         self.nonce: Optional[int] = None
+        #: bounded outbound job queue drained by the writer thread.
+        #: CPython deque appends are atomic, so senders enqueue
+        #: LOCK-FREE; the writer (single consumer) assigns sequence
+        #: numbers, stamps egress windows and runs fault verdicts in
+        #: pop order, which IS the stream order.
+        self.outq: deque = deque()
+        #: writer wake-up: set by senders on the empty->nonempty
+        #: transition (Event.set is thread-safe and needs no lock),
+        #: cleared by the writer before it sleeps
+        self.out_ev = threading.Event()
+        #: space-available signal for backpressured senders (rare path;
+        #: the only remaining use of ``lock`` on the send side)
+        self.out_cv = threading.Condition(self.lock)
+        self.writer: Optional[threading.Thread] = None
+        #: transport capabilities the peer's hello advertised ("fb" =
+        #: understands multi-frame batch units)
+        self.caps: frozenset = frozenset()
 
 
 class _Corrupt:
@@ -254,6 +280,12 @@ class _Conn:
         body = self._read_exact(n)
         if body is None:
             return None
+        if body[:4] == wire.FB_MAGIC:
+            # Multi-frame batch unit (only ever sent to peers that
+            # advertised the "fb" capability, i.e. this code).  Per-block
+            # corruption surfaces as (seq, None) entries, never as a
+            # stream error.
+            return ("fb", wire.decode_batch(body))
         try:
             return pickle.loads(body)
         except Exception:
@@ -321,6 +353,16 @@ class NodeFabric:
         self._hb = None  # HeartbeatMonitor when enabled by config
         self._reconnect_retries = 0
         self._reconnect_backoff_s = 0.05
+        #: advertise + use multi-frame batch units ("fb" capability).
+        #: Off, this node sends classic singleton units (still through
+        #: the writer thread, one flush per frame) and its hello stays
+        #: at the legacy 5-element shape.
+        self._batching = True
+        #: writer-queue high-water mark (frames); senders to one peer
+        #: block briefly once its queue is this deep (backpressure)
+        self._writer_high_water = 8192
+        #: max frames coalesced into one batch flush
+        self._max_batch_frames = 256
         #: this process-incarnation's identity, exchanged in the hello:
         #: a reconnect that reaches a RESTARTED peer (same address, new
         #: process) must not resume the old frame stream — its sequence
@@ -347,6 +389,9 @@ class NodeFabric:
         self._reconnect_backoff_s = (
             config.get_int("uigc.node.reconnect-backoff") / 1000.0
         )
+        self._batching = config.get_bool("uigc.node.frame-batching")
+        self._writer_high_water = config.get_int("uigc.node.writer-queue-limit")
+        self._max_batch_frames = config.get_int("uigc.node.max-batch-frames")
         hb_ms = config.get_int("uigc.node.heartbeat-interval")
         if hb_ms > 0:
             from .heartbeat import HeartbeatMonitor
@@ -437,6 +482,12 @@ class NodeFabric:
     def _hello(self) -> tuple:
         bk = self.system.engine.bookkeeper_cell
         names = {n: c.uid for n, c in self._names.items()}
+        if self._batching:
+            # Capability negotiation: the trailing caps element tells the
+            # peer it may send us multi-frame batch units.  Omitted when
+            # batching is off, which keeps the legacy 5-element shape —
+            # the exact hello an older build emits.
+            return ("hello", self.address, names, bk.uid, self._nonce, ("fb",))
         return ("hello", self.address, names, bk.uid, self._nonce)
 
     def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -512,10 +563,20 @@ class NodeFabric:
         was already declared dead (a removed member cannot silently
         rejoin — recovery already reverted its effects) or when a known
         address presents a NEW incarnation nonce (the old process died;
-        a restarted one may not resume its frame stream)."""
-        _, address, names, bk_uid, nonce = hello
+        a restarted one may not resume its frame stream).
+
+        Tolerant unpack: the hello is ``(kind, address, names, bk_uid,
+        nonce)`` with an optional trailing capabilities element — never
+        destructure to a fixed arity, so hellos from peers with or
+        without batching (or with future extra elements) all parse."""
+        address, names, bk_uid, nonce = hello[1], hello[2], hello[3], hello[4]
+        try:
+            caps = frozenset(hello[5]) if len(hello) > 5 else frozenset()
+        except TypeError:
+            caps = frozenset()
         conn.address = address
         st = self._peer_state(address)
+        st.caps = caps
         with self._lock:
             if address in self.crashed:
                 return False
@@ -547,6 +608,12 @@ class NodeFabric:
         return True
 
     def _peer_state(self, address: str) -> _PeerState:
+        # Lock-free fast path: dict reads are atomic under the GIL and
+        # peer states are never removed, only created — the send path
+        # hits this per frame.
+        st = self._peers.get(address)
+        if st is not None:
+            return st
         with self._lock:
             st = self._peers.get(address)
             if st is None:
@@ -576,89 +643,314 @@ class NodeFabric:
                 self._frame_handlers[kind] = handler
 
     def send_frame(self, dst_address: str, inner: tuple) -> bool:
-        """Transmit one subsystem frame to a live peer through the
-        sequence layer and the fault plan (the same path app frames
-        ride).  Returns False when there is no live link."""
-        conn = self._conn_for(dst_address)
-        if conn is None:
-            return False
-        return self._send_frame(dst_address, inner, conn)
+        """Hand one subsystem frame to a live peer's writer; it rides
+        the sequence layer and the fault plan in stream order (the same
+        path app frames take).  Returns False when there is no live
+        link; True means *accepted for transmission* — the writer
+        flushes asynchronously, and a link that breaks mid-flush
+        surfaces as a structured ``fabric.send_failed`` event (with the
+        peer and frame kind) rather than a silent bool."""
+        return self._send_frame(dst_address, inner)
 
     # ------------------------------------------------------------- #
-    # Frame transmission (seq layer + fault injection)
+    # Frame transmission (writer thread: seq layer + fault injection)
+    #
+    # Senders never lock: a send is one atomic deque append plus (on
+    # the empty->nonempty transition) an Event.set.  The per-peer
+    # writer is the queue's single consumer; it stamps egress windows,
+    # claims sequence numbers and runs fault-plan verdicts in pop
+    # order — which therefore IS the stream order — then coalesces
+    # everything drained into one sendall.
     # ------------------------------------------------------------- #
 
     def _send_frame(self, dst_address: str, inner: tuple, conn: Optional[_Conn] = None) -> bool:
-        """Transmit one frame on the link to ``dst_address`` through the
-        sequence layer and the fault plan.  Every verdict — including a
-        drop — consumes a sequence number, so the receiver can tell
-        "lost in flight" (gap) from "never sent"."""
+        """Queue one pre-built frame for ``dst_address``."""
         if conn is None:
             conn = self._conn_for(dst_address)
         if conn is None:
             return False
-        st = self._peer_state(dst_address)
-        plan = self.fault_plan
-        kind = inner[0]
-        broken = False
+        self._enqueue_job(dst_address, self._peer_state(dst_address), ("f", inner))
+        return True
+
+    def _enqueue_job(self, address: str, st: _PeerState, job: tuple) -> None:
+        if len(st.outq) >= self._writer_high_water:
+            # Backpressure (rare path): a peer whose writer cannot keep
+            # up stalls its senders instead of growing the queue
+            # unboundedly.  The writer notifies after each drain.
+            with st.out_cv:
+                while (
+                    len(st.outq) >= self._writer_high_water and not self._closing
+                ):
+                    st.out_cv.wait(0.1)
+        st.outq.append(job)
+        if not st.out_ev.is_set():
+            st.out_ev.set()
+        if st.writer is None:
+            self._start_writer(address, st)
+
+    def _start_writer(self, address: str, st: _PeerState) -> None:
         with st.lock:
-            if plan is None:
-                action, frames = faults.DELIVER, 0
-            else:
-                action, frames = plan.outbound(self.address, dst_address, kind)
-            st.seq_out += 1
-            seq = st.seq_out
+            if st.writer is not None:
+                return
+            st.writer = threading.Thread(
+                target=self._writer_loop,
+                args=(address, st),
+                name=f"node-writer-{address}",
+                daemon=True,
+            )
+            st.writer.start()
+
+    def _writer_loop(self, address: str, st: _PeerState) -> None:
+        """Per-peer outbound writer: drains the job queue, stamps and
+        sequences in pop order, encodes off every sender path, and
+        flushes each drain in ONE sendall — a multi-frame ``"fb"``
+        batch when the peer advertised the capability, a concatenation
+        of classic singleton units otherwise (old peers still parse
+        unit-by-unit; only the syscalls coalesce)."""
+        events.set_thread_origin(self.address or None)
+        max_batch = self._max_batch_frames
+        outq = st.outq
+        while True:
+            if not outq:
+                st.out_ev.clear()
+                if outq:
+                    # An append raced the clear: keep the event set so a
+                    # concurrent sender's skipped set() cannot be lost.
+                    st.out_ev.set()
+                elif self._closing or address in self.crashed:
+                    # Node closing, or this peer is terminally dead (no
+                    # send path can enqueue for it anymore): exit.
+                    return
+                else:
+                    # Unbounded wait — zero wakeups on an idle link.
+                    # Every transition out of idle sets the event:
+                    # senders on enqueue, close() on teardown,
+                    # _declare_dead on the peer's death verdict.
+                    st.out_ev.wait()
+                    continue
+            was_backpressured = len(outq) >= self._writer_high_water
+            jobs: list = []
+            try:
+                while len(jobs) < max_batch:
+                    jobs.append(outq.popleft())
+            except IndexError:
+                pass
+            if was_backpressured:
+                with st.out_cv:
+                    st.out_cv.notify_all()
+            plan = self.fault_plan
             transmit: list = []
-            if action == faults.DROP:
-                events.recorder.commit(
-                    events.FRAME_DROPPED,
-                    src=self.address,
-                    dst=dst_address,
-                    kind=kind,
-                )
-            elif action == faults.DUPLICATE:
-                transmit = [(seq, inner, False), (seq, inner, False)]
-            elif action == faults.TRUNCATE:
-                transmit = [(seq, inner, True)]
-            elif action == faults.REORDER and st.held is None:
-                st.held = (seq, inner, False)
-            elif action == faults.DELAY:
-                st.stall = max(st.stall, frames)
-                st.stall_q.append((seq, inner, False))
-            else:
-                transmit = [(seq, inner, False)]
-
-            if transmit and st.stall > 0:
-                # Link stalled: absorb in order, release when drained.
-                st.stall_q.extend(transmit)
-                st.stall -= 1
-                transmit = []
-                if st.stall <= 0:
-                    transmit = st.stall_q
-                    st.stall_q = []
-            if transmit and st.held is not None:
-                # Release the reordered frame AFTER the newer one(s) —
-                # including a stall-queue drain, so combining delay and
-                # reorder rules cannot strand the held frame while
-                # traffic continues.  (A held or stalled frame on a link
-                # that goes PERMANENTLY quiet is never transmitted; that
-                # is the documented fault model — it becomes a drop.)
-                transmit = transmit + [st.held]
-                st.held = None
-
-            for sq, fr, trunc in transmit:
+            crash = False
+            for job in jobs:
                 try:
-                    conn.send_bytes(_frame_bytes(("f", sq, fr), trunc))
-                except OSError:
-                    broken = True
+                    inner = self._job_inner(job)
+                except Exception:  # pragma: no cover - defensive
+                    traceback.print_exc()
+                    continue
+                if inner is None:
+                    continue
+                kind = inner[0]
+                self._apply_verdict(st, address, inner, kind, plan, transmit)
+                if plan is not None and plan.record_sent(self.address, kind):
+                    # Scheduled crash point: everything up to and
+                    # including this frame flushes, the rest is lost —
+                    # kill -9 at a deterministic stream position.
+                    crash = True
                     break
-        crash = plan is not None and plan.record_sent(self.address, kind)
-        if broken:
-            self._on_conn_broken(dst_address, conn)
-        if crash:
-            self.die(reason="fault-plan")
-            return False
-        return not broken
+            self._flush_items(address, st, transmit)
+            if crash:
+                self.die(reason="fault-plan")
+                return
+
+    def _job_inner(self, job: tuple) -> Optional[tuple]:
+        """Turn a queued job into its inner frame tuple, running the
+        stateful egress steps (window stamp / window roll) that must
+        happen in stream order.  Writer-thread only."""
+        tag = job[0]
+        if tag == "f":
+            return job[1]
+        if tag == "a":
+            _tag, link, target, msg, header = job
+            if link.egress is not None:
+                link.egress.on_message(target, msg)
+            if header is not None:
+                return ("app", target.uid, msg, header)
+            return ("app", target.uid, msg)
+        # "m": roll the egress window and emit its boundary marker.
+        link = job[1]
+        if link.egress is None:
+            return None
+        return ("marker", link.egress.finalize_entry().id)
+
+    def _apply_verdict(
+        self,
+        st: _PeerState,
+        dst_address: str,
+        inner: tuple,
+        kind: str,
+        plan: Optional[faults.FaultPlan],
+        transmit: list,
+    ) -> None:
+        """Sequence claim + fault-plan verdict for one frame, appending
+        what should hit the wire to ``transmit`` as (seq, inner,
+        truncate) triples.  Every verdict — including a drop — consumes
+        a sequence number, so the receiver can tell "lost in flight"
+        (gap) from "never sent".  Writer-thread only: st.seq_out,
+        st.held and the stall queue have a single mutator."""
+        if plan is None:
+            action, frames = faults.DELIVER, 0
+        else:
+            action, frames = plan.outbound(self.address, dst_address, kind)
+        st.seq_out += 1
+        seq = st.seq_out
+        out: list = []
+        if action == faults.DROP:
+            events.recorder.commit(
+                events.FRAME_DROPPED,
+                src=self.address,
+                dst=dst_address,
+                kind=kind,
+            )
+        elif action == faults.DUPLICATE:
+            out = [(seq, inner, False), (seq, inner, False)]
+        elif action == faults.TRUNCATE:
+            out = [(seq, inner, True)]
+        elif action == faults.REORDER and st.held is None:
+            st.held = (seq, inner, False)
+        elif action == faults.DELAY:
+            st.stall = max(st.stall, frames)
+            st.stall_q.append((seq, inner, False))
+        else:
+            out = [(seq, inner, False)]
+
+        if out and st.stall > 0:
+            # Link stalled: absorb in order, release when drained.
+            st.stall_q.extend(out)
+            st.stall -= 1
+            out = []
+            if st.stall <= 0:
+                out = st.stall_q
+                st.stall_q = []
+        if out and st.held is not None:
+            # Release the reordered frame AFTER the newer one(s) —
+            # including a stall-queue drain, so combining delay and
+            # reorder rules cannot strand the held frame while
+            # traffic continues.  (A held or stalled frame on a link
+            # that goes PERMANENTLY quiet is never transmitted; that
+            # is the documented fault model — it becomes a drop.)
+            out = out + [st.held]
+            st.held = None
+        transmit.extend(out)
+
+    def _flush_items(self, address: str, st: _PeerState, items: list) -> None:
+        """Encode and flush one drained batch in a single sendall."""
+        if not items:
+            return
+        conn = self._conn_for(address)
+        if conn is None:
+            # Peer dead or link torn down: the frames are lost (the
+            # receiver will account them as a gap) — but never
+            # silently; each protocol frame surfaces an event.
+            self._report_send_failed(address, items)
+            return
+        # Pickle app payloads here, off every sender path: an
+        # unencodable one is dropped (gap at the receiver, like any
+        # lost-in-flight frame) with a send_failed event, never a
+        # wedged link.
+        encoded = []
+        for item in items:
+            try:
+                encoded.append(
+                    (item[0], self._materialize_frame(item[1]), item[2])
+                )
+            except Exception:
+                traceback.print_exc()
+                self._report_send_failed(address, [item])
+        if not encoded:
+            return
+        use_fb = self._batching and "fb" in st.caps
+        try:
+            if use_fb:
+                body = wire.encode_batch(
+                    (sq, wire.encode_block(fr, trunc))
+                    for sq, fr, trunc in encoded
+                )
+                buf = struct.pack(">I", len(body)) + body
+            else:
+                buf = b"".join(
+                    _frame_bytes(("f", sq, fr), trunc)
+                    for sq, fr, trunc in encoded
+                )
+            conn.send_bytes(buf)
+        except OSError:
+            self._report_send_failed(address, encoded)
+            self._on_conn_broken(address, conn)
+            return
+        if events.recorder.enabled and use_fb:
+            events.recorder.commit(
+                events.FRAME_BATCH,
+                dst=address,
+                size=len(encoded),
+                bytes=len(buf),
+            )
+
+    @staticmethod
+    def _materialize_frame(frame: tuple) -> tuple:
+        """Late payload serialization: an app frame queued by deliver()
+        carries the message object; replace it with its pickled bytes
+        (``wire.encode_message``) just before the wire.  Every app
+        payload is encoded — sniffing ``isinstance(payload, bytes)``
+        would misread a user message that IS a bytes object as already
+        encoded and ship it raw.  Non-app frames (subsystem frames,
+        control gossip) pass through untouched; nothing re-enters this
+        step, so double-encoding cannot occur."""
+        if frame[0] == "app":
+            return (frame[0], frame[1], wire.encode_message(frame[2])) + tuple(
+                frame[3:]
+            )
+        return frame
+
+    def _report_send_failed(self, address: str, items: list) -> None:
+        """A flush could not reach the peer: emit one structured
+        ``fabric.send_failed`` event per lost protocol frame (heartbeats
+        excluded — they are timer-driven noise on a dying link), unless
+        this whole node is going down anyway."""
+        if self._closing:
+            return
+        for _sq, inner, _trunc in items:
+            kind = inner[0]
+            if kind == "hb":
+                continue
+            events.recorder.commit(
+                events.SEND_FAILED, dst=address, kind=kind
+            )
+
+    def writer_queue_depths(self) -> Dict[str, int]:
+        """Frames queued per peer writer — the telemetry gauge tap
+        (``uigc_writer_queue_depth``; approximate by nature)."""
+        with self._lock:
+            peers = list(self._peers.items())
+        return {address: len(st.outq) for address, st in peers}
+
+    def flush_writers(self, timeout_s: float = 5.0) -> bool:
+        """Wait until every peer writer queue is drained (tests, the
+        pre-crash drain in ``die()``, graceful teardown).  When called
+        FROM a writer thread (a fault-plan crash point), that writer's
+        own queue is excluded — it cannot drain itself while waiting."""
+        me = threading.current_thread()
+        with self._lock:
+            peers = list(self._peers.items())
+        waiting = [st for _a, st in peers if st.writer is not me]
+
+        def drained() -> bool:
+            return all(not st.outq for st in waiting)
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if drained():
+                return True
+            time.sleep(0.002)
+        return drained()
 
     # ------------------------------------------------------------- #
     # Receive path
@@ -676,6 +968,12 @@ class NodeFabric:
                 self._hb.record(conn.address)
             if frame is _CORRUPT:
                 events.recorder.commit(events.FRAME_CORRUPT, src=conn.address)
+                continue
+            if frame[0] == "fb":
+                try:
+                    self._on_batch(conn.address, frame[1])
+                except Exception:  # pragma: no cover - keep the link alive
+                    traceback.print_exc()
                 continue
             if frame[0] == "f":
                 _, seq, inner = frame
@@ -815,6 +1113,11 @@ class NodeFabric:
         events.recorder.commit(
             events.NODE_DOWN, address=address, reason=reason, **fields
         )
+        # Wake the peer's writer so it observes the verdict and exits
+        # (it may be in its unbounded idle wait).
+        st = self._peers.get(address)
+        if st is not None:
+            st.out_ev.set()
         if self._hb is not None:
             self._hb.forget(address)
         if conn is not None:
@@ -851,6 +1154,9 @@ class NodeFabric:
         return self._in_link(src.address)
 
     def _out_link(self, dst_address: str) -> _HalfLink:
+        l = self._out.get(dst_address)  # lock-free fast path (GIL-atomic)
+        if l is not None:
+            return l
         with self._lock:
             l = self._out.get(dst_address)
             if l is None:
@@ -858,10 +1164,16 @@ class NodeFabric:
                 l.egress = self.system.engine.spawn_egress(
                     _LinkFacade(self.system, ProxySystem(dst_address))
                 )
+                # NOTE: the egress is only ever touched by the peer's
+                # writer thread (stamps and window rolls run in queue
+                # order there), so l.send_lock is unused on this fabric.
                 self._out[dst_address] = l
             return l
 
     def _in_link(self, src_address: str) -> _HalfLink:
+        l = self._in.get(src_address)  # lock-free fast path (GIL-atomic)
+        if l is not None:
+            return l
         with self._lock:
             l = self._in.get(src_address)
             if l is None:
@@ -888,10 +1200,13 @@ class NodeFabric:
     # ------------------------------------------------------------- #
 
     def _conn_for(self, address: str) -> Optional[_Conn]:
-        with self._lock:
-            if address in self.crashed:
-                return None
-            return self._conns.get(address)
+        # Lock-free: set/dict reads are atomic under the GIL, and the
+        # worst stale read (a conn replaced or a crash verdict landing
+        # concurrently) is indistinguishable from the frame having been
+        # queued a moment earlier — the writer re-reads at flush time.
+        if address in self.crashed:
+            return None
+        return self._conns.get(address)
 
     def deliver(self, src: "ActorSystem", target: ProxyCell, msg: Any) -> None:
         dst_address = target.system.address
@@ -902,29 +1217,33 @@ class NodeFabric:
         # engine stamped on the envelope also rides the frame, OUTSIDE
         # the payload bytes, so the receiver can adopt it before (and
         # regardless of) payload decode.  Peers without tracing ignore
-        # the extra element — see _on_frame's tolerant unpack.
+        # the extra element — see _deliver_app_run's tolerant unpack.
         header = wire.encode_trace_header(msg)
         link = self._out_link(dst_address)
-        with link.send_lock:
-            if link.egress is not None:
-                link.egress.on_message(target, msg)
-            payload = wire.encode_message(msg)
-            if header is not None:
-                frame = ("app", target.uid, payload, header)
-            else:
-                frame = ("app", target.uid, payload)
-            self._send_frame(dst_address, frame, conn)
+        st = self._peer_state(dst_address)
+        # The job carries the message OBJECT; the writer thread stamps
+        # the egress window, claims the sequence number AND pickles the
+        # payload at flush time, in queue order — senders pay one
+        # lock-free deque append.  The stamp is part of the pickled
+        # envelope, so the message must not be mutated after tell(),
+        # the same snapshot discipline every serializing transport
+        # imposes.
+        self._enqueue_job(dst_address, st, ("a", link, target, msg, header))
 
     def finalize_egress(self, src: "ActorSystem", dst_address: str) -> None:
         conn = self._conn_for(dst_address)
         if conn is None:
             return
         link = self._out_link(dst_address)
-        with link.send_lock:
-            if link.egress is None:
-                return
-            marker = link.egress.finalize_entry()
-            self._send_frame(dst_address, ("marker", marker.id), conn)
+        if link.egress is None:
+            return
+        # The window roll happens ON the writer, in queue order: every
+        # app message appended before this job is stamped with the
+        # closing window, everything after it with the next one — the
+        # same atomicity the old send-lock provided, without a lock.
+        self._enqueue_job(
+            dst_address, self._peer_state(dst_address), ("m", link)
+        )
 
     def finalize_dead_link(self, src_address: str, dst: "ActorSystem") -> None:
         with self._lock:
@@ -961,25 +1280,99 @@ class NodeFabric:
     # Frame dispatch (receiver side)
     # ------------------------------------------------------------- #
 
-    def _on_frame(self, from_address: str, frame: tuple) -> None:
-        kind = frame[0]
-        if kind == "app":
-            # Tolerant unpack: the frame is (kind, uid, payload) with an
-            # optional trailing trace header — never destructure to a
-            # fixed arity, so frames from peers with or without tracing
-            # (or with future extra elements) all decode.
-            uid, payload = frame[1], frame[2]
-            msg = wire.decode_message(self, payload)
-            tel = self.system.telemetry
-            if tel is not None and tel.tracer.enabled:
+    def _on_batch(self, from_address: str, entries: list) -> None:
+        """Decode one ``"fb"`` unit: sequence accounting runs per inner
+        frame in ONE pass under the receive lock (gap/duplicate
+        semantics identical to the singleton path), then app frames are
+        delivered to local cells in per-cell runs — a burst to one actor
+        schedules one dispatcher batch instead of N."""
+        st = self._peer_state(from_address)
+        accepted: list = []
+        corrupt = 0
+        dup_seqs: list = []
+        gap_counts: list = []
+        with st.rlock:
+            for seq, inner in entries:
+                if inner is None:
+                    # Pre-seq loss, exactly like a truncated singleton
+                    # unit: the frame never reaches the seq layer, so a
+                    # later frame raises the gap.
+                    corrupt += 1
+                    continue
+                if seq <= st.seq_in:
+                    st.dups += 1
+                    dup_seqs.append(seq)
+                    continue
+                if seq > st.seq_in + 1:
+                    missed = seq - st.seq_in - 1
+                    st.gaps += missed
+                    gap_counts.append(missed)
+                st.seq_in = seq
+                if inner[0] == "hb":
+                    continue
+                accepted.append(inner)
+        for _ in range(corrupt):
+            events.recorder.commit(events.FRAME_CORRUPT, src=from_address)
+        for seq in dup_seqs:
+            events.recorder.commit(
+                events.FRAME_DUPLICATE, src=from_address, seq=seq
+            )
+        for missed in gap_counts:
+            events.recorder.commit(
+                events.FRAME_GAP, src=from_address, missed=missed
+            )
+        i = 0
+        n = len(accepted)
+        while i < n:
+            inner = accepted[i]
+            if inner[0] != "app":
+                try:
+                    self._on_frame(from_address, inner)
+                except Exception:  # pragma: no cover - keep the link alive
+                    traceback.print_exc()
+                i += 1
+                continue
+            uid = inner[1]
+            j = i + 1
+            while j < n and accepted[j][0] == "app" and accepted[j][1] == uid:
+                j += 1
+            try:
+                self._deliver_app_run(from_address, uid, accepted[i:j])
+            except Exception:  # pragma: no cover - keep the link alive
+                traceback.print_exc()
+            i = j
+
+    def _deliver_app_run(
+        self, from_address: str, uid: int, frames: List[tuple]
+    ) -> None:
+        """Deliver a run of app frames addressed to one uid: decode and
+        filter each message, then tally and enqueue the surviving run
+        under ONE ``recv_lock`` hold and one mailbox/scheduling pass.
+
+        Each frame is (kind, uid, payload) with an optional trailing
+        trace header — tolerant unpack, so frames from peers with or
+        without tracing (or with future extra elements) all decode."""
+        link = self._in_link(from_address)
+        tel = self.system.telemetry
+        tracing = tel is not None and tel.tracer.enabled
+        plan = self.fault_plan
+        msgs: list = []
+        for frame in frames:
+            try:
+                msg = wire.decode_message(self, frame[2])
+            except Exception:
+                # One undecodable payload must not void the rest of the
+                # run (the singleton path lost exactly one frame too).
+                traceback.print_exc()
+                continue
+            if tracing:
                 wire.apply_trace_header(
                     msg,
                     wire.decode_trace_header(frame[3] if len(frame) > 3 else None),
                 )
-            link = self._in_link(from_address)
             if link.drop_filter is not None and link.drop_filter(msg):
-                return
-            if self.fault_plan is not None and self.fault_plan.drop_inbound(
+                continue
+            if plan is not None and plan.drop_inbound(
                 from_address, self.address, msg
             ):
                 events.recorder.commit(
@@ -988,30 +1381,49 @@ class NodeFabric:
                     dst=self.address,
                     kind="app",
                 )
-                return
-            cell = self.system.resolve_cell(uid)
-            if cell is None:
-                # Post-mortem frame: the recipient terminated and was
-                # reclaimed.  The sender's egress already stamped this
-                # send into a window, so it MUST still tally on the
-                # ingress (keyed by the stable tombstone proxy) or the
-                # link's recv balance never returns to zero after the
-                # sender dies; and the refs the message carries must be
-                # released or their targets leak across processes.
-                # record_dead_letter routes through the engine's
-                # dead-letter accounting (CRGC.on_dead_letter).
-                tombstone = self._proxy(self.address, uid)
-                with link.recv_lock:
-                    if link.ingress is not None:
-                        link.ingress.on_message(tombstone, msg)
-                # record_dead_letter emits the fabric.dead_letter event
-                # (the tombstone's path carries the origin uid).
-                self.system.record_dead_letter(tombstone, msg)
-                return
+                continue
+            msgs.append(msg)
+        if not msgs:
+            return
+        cell = self.system.resolve_cell(uid)
+        if cell is None:
+            # Post-mortem frames: the recipient terminated and was
+            # reclaimed.  The sender's egress already stamped these
+            # sends into a window, so they MUST still tally on the
+            # ingress (keyed by the stable tombstone proxy) or the
+            # link's recv balance never returns to zero after the
+            # sender dies; and the refs each message carries must be
+            # released or their targets leak across processes.
+            # record_dead_letter routes through the engine's
+            # dead-letter accounting (CRGC.on_dead_letter).
+            tombstone = self._proxy(self.address, uid)
             with link.recv_lock:
                 if link.ingress is not None:
+                    for msg in msgs:
+                        link.ingress.on_message(tombstone, msg)
+            # record_dead_letter emits the fabric.dead_letter event
+            # (the tombstone's path carries the origin uid).
+            for msg in msgs:
+                self.system.record_dead_letter(tombstone, msg)
+            return
+        with link.recv_lock:
+            if link.ingress is not None:
+                for msg in msgs:
                     link.ingress.on_message(cell, msg)
-                cell.tell(msg)
+            # enqueue under recv_lock keeps mailbox order consistent
+            # with the ingress tally order (per-link FIFO all the way
+            # down); tell_batch appends the whole run with one lock
+            # acquisition and at most one dispatcher submission.
+            if len(msgs) == 1 or not hasattr(cell, "tell_batch"):
+                for msg in msgs:
+                    cell.tell(msg)
+            else:
+                cell.tell_batch(msgs)
+
+    def _on_frame(self, from_address: str, frame: tuple) -> None:
+        kind = frame[0]
+        if kind == "app":
+            self._deliver_app_run(from_address, frame[1], [frame])
         elif kind == "marker":
             link = self._in_link(from_address)
             with link.recv_lock:
@@ -1055,6 +1467,12 @@ class NodeFabric:
         EOF (or heartbeat silence, if the plan muted the links first)."""
         if self._closing:
             return
+        # Best-effort drain BEFORE the closing flag: frames that were
+        # accepted before the crash point should reach the wire (the
+        # pre-writer transport had already sendall()'d them), while
+        # anything enqueued after this instant is lost — kill -9 loses
+        # exactly the unflushed tail.
+        self.flush_writers(timeout_s=1.0)
         self._closing = True  # suppress break handling during teardown
         events.recorder.commit(
             events.NODE_CRASHED, address=self.address, reason=reason
@@ -1067,7 +1485,21 @@ class NodeFabric:
         self.close()
 
     def close(self) -> None:
+        if not self._closing:
+            # Graceful close drains what was already accepted: a frame
+            # deliver() queued must not silently vanish on a healthy
+            # link just because terminate ran first (the pre-writer
+            # transport had sendall()'d it by now).  Dead links drain
+            # fast — their writer pops and drops.  die() performs its
+            # own (shorter) drain before setting the flag.
+            self.flush_writers(timeout_s=2.0)
         self._closing = True
+        with self._lock:
+            peers = list(self._peers.values())
+        for st in peers:  # wake writers + backpressured senders
+            st.out_ev.set()
+            with st.lock:
+                st.out_cv.notify_all()
         if self._hb is not None:
             self._hb.stop()
         if self._listener is not None:

@@ -21,7 +21,9 @@ from __future__ import annotations
 
 import io
 import pickle
-from typing import TYPE_CHECKING, Any
+import struct
+from collections import deque
+from typing import TYPE_CHECKING, Any, Iterable, List, Optional, Tuple
 
 if TYPE_CHECKING:  # pragma: no cover
     from .fabric import Fabric
@@ -81,36 +83,72 @@ def _entity_ref_class():
     return _ENTITY_REF
 
 
-class _Pickler(pickle.Pickler):
-    def persistent_id(self, obj: Any):
+_REF_CLASSES = None
+
+
+def _ref_classes():
+    """Lazy, cached (CrgcRefob, Refob, ActorCell, RawRef) tuple — these
+    imports sat inside ``persistent_id`` and were re-resolved through the
+    import machinery for every object pickled on the hot send path."""
+    global _REF_CLASSES
+    if _REF_CLASSES is None:
         from ..engines.crgc.refob import CrgcRefob
         from ..interfaces import Refob
         from .cell import ActorCell
         from .system import RawRef
+
+        _REF_CLASSES = (CrgcRefob, Refob, ActorCell, RawRef)
+    return _REF_CLASSES
+
+
+#: Memoized persistent-id tokens for long-lived handle objects whose wire
+#: token never changes: ProxyCell and EntityRef (both cached per fabric /
+#: region) and ActorCell.  Keyed by ``id(obj)`` WITH the object pinned in
+#: the entry, so a reused id can never alias a dead object's token.
+#: Bounded: cleared wholesale at the cap (cheap; it re-warms in one burst).
+_PID_CACHE: dict = {}
+_PID_CACHE_MAX = 4096
+
+
+class _Pickler(pickle.Pickler):
+    def persistent_id(self, obj: Any):
+        cached = _PID_CACHE.get(id(obj))
+        if cached is not None and cached[0] is obj:
+            return cached[1]
+        CrgcRefob, Refob, ActorCell, RawRef = _ref_classes()
 
         if isinstance(obj, _entity_ref_class()):
             # Location-transparent: an entity ref crosses as its
             # (type, key) coordinates and re-binds to the DESTINATION
             # node's shard region — never to a concrete cell, which may
             # passivate or migrate while the message is in flight.
-            return ("entity", obj.type_name, obj.key)
-        if isinstance(obj, CrgcRefob):
+            pid = ("entity", obj.type_name, obj.key)
+        elif isinstance(obj, CrgcRefob):
             t = obj._target
             return ("refob", t.system.address, t.uid)
-        if isinstance(obj, Refob):
+        elif isinstance(obj, Refob):
             # engine-agnostic fallback: re-materialize through the
             # destination engine's root conversion
             t = obj.target
             return ("ref", t.system.address, t.uid)
-        if isinstance(obj, ActorCell):
-            return ("cell", obj.system.address, obj.uid)
-        if isinstance(obj, _proxy_cell_class()):
+        elif isinstance(obj, _proxy_cell_class()):
             # A remote handle crossing another link re-encodes to the
-            # same (address, uid) token it was decoded from.
+            # same (address, uid) token it was decoded from.  Cached:
+            # proxies are pinned by the fabric's identity cache anyway.
+            pid = ("cell", obj.system.address, obj.uid)
+        elif isinstance(obj, ActorCell):
+            # NOT cached: pinning a cell here would keep a terminated
+            # actor alive past its weak-registry reclamation and mask
+            # the tombstone/dead-letter path.
             return ("cell", obj.system.address, obj.uid)
-        if isinstance(obj, RawRef):
+        elif isinstance(obj, RawRef):
             return ("rawref", obj.cell.system.address, obj.cell.uid)
-        return None
+        else:
+            return None
+        if len(_PID_CACHE) >= _PID_CACHE_MAX:
+            _PID_CACHE.clear()
+        _PID_CACHE[id(obj)] = (obj, pid)
+        return pid
 
 
 class _Unpickler(pickle.Unpickler):
@@ -158,14 +196,148 @@ class _Unpickler(pickle.Unpickler):
         return cell
 
 
+#: Pooled (BytesIO, _Pickler) pairs: a ``tell()`` to a remote proxy used
+#: to pay a fresh pickler allocation per message; the pool amortizes it
+#: to a deque pop + memo clear.  CPython deque append/popleft are atomic,
+#: so the pool is thread-safe without a lock.
+_PICKLER_POOL: deque = deque()
+_PICKLER_POOL_MAX = 16
+
+
 def encode_message(msg: Any) -> bytes:
-    buf = io.BytesIO()
-    _Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(msg)
-    return buf.getvalue()
+    try:
+        buf, pickler = _PICKLER_POOL.popleft()
+    except IndexError:
+        buf = io.BytesIO()
+        pickler = _Pickler(buf, protocol=pickle.HIGHEST_PROTOCOL)
+    try:
+        pickler.dump(msg)
+        data = buf.getvalue()
+    finally:
+        # Reusable even after a failed dump: the memo is cleared and the
+        # buffer rewound, so partial output never leaks into the next use.
+        pickler.clear_memo()
+        buf.seek(0)
+        buf.truncate()
+        if len(_PICKLER_POOL) < _PICKLER_POOL_MAX:
+            _PICKLER_POOL.append((buf, pickler))
+    return data
 
 
 def decode_message(fabric: "Fabric", data: bytes) -> Any:
     return _Unpickler(io.BytesIO(data), fabric).load()
+
+
+# ------------------------------------------------------------------- #
+# Frame-batch wire units (the node transport's ``"fb"`` kind)
+#
+# The per-peer writer thread (runtime/node.py) coalesces every frame
+# queued for one peer into a single length-prefixed multi-frame batch,
+# flushed in ONE sendall.  The capability is negotiated in the hello
+# tuple (a trailing ``("fb",)`` caps element); peers that never
+# advertised it receive classic singleton units, so mixed-version links
+# keep working.
+#
+# A batch body is distinguished from a pickled singleton by a magic
+# prefix that can never begin a protocol-2+ pickle (those start with
+# b"\x80").  Inside the batch each frame carries its own sequence number
+# and an inner block whose first byte selects the block codec:
+#
+#   body  := MAGIC  frame*
+#   frame := ">QI"(seq, len(block))  block
+#   block := b"A" ">QI"(uid, len(payload)) payload header-pickle?   (app)
+#          | b"P" pickle(inner-frame-tuple)                     (generic)
+#
+# The ``A`` block is the zero-realloc app envelope: the payload is the
+# already-pickled message bytes, framed with struct instead of being
+# re-pickled wholesale the way the singleton path's frame tuple was.
+# Truncation (fault injection) cuts one BLOCK while keeping its recorded
+# length consistent, so exactly that inner frame fails to decode and the
+# rest of the batch — and the stream — survive.
+# ------------------------------------------------------------------- #
+
+FB_MAGIC = b"\x00FB1"
+_FB_HDR = struct.Struct(">QI")
+
+
+def encode_block(inner: tuple, truncate: bool = False) -> bytes:
+    """Encode one inner frame tuple as a batch block.  ``truncate``
+    (fault injection) must make exactly this block undecodable: for app
+    blocks the cut is taken over the headerless envelope+payload span —
+    cutting only a trailing trace header would deliver the message
+    intact (headers are decode-tolerant by design)."""
+    if inner[0] == "app":
+        payload = inner[2]
+        header = inner[3] if len(inner) > 3 else None
+        envelope = b"A" + _FB_HDR.pack(inner[1], len(payload)) + payload
+        if truncate:
+            return envelope[: max(4, len(envelope) // 2)]
+        if header is None:
+            return envelope
+        return envelope + pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+    block = b"P" + pickle.dumps(inner, protocol=pickle.HIGHEST_PROTOCOL)
+    if truncate:
+        block = block[: max(4, len(block) // 2)]
+    return block
+
+
+def decode_block(block: bytes):
+    """-> the inner frame tuple, or None when the block is corrupt."""
+    if not block:
+        return None
+    kind = block[0:1]
+    if kind == b"A":
+        if len(block) < 13:
+            return None
+        uid, plen = _FB_HDR.unpack_from(block, 1)
+        payload = block[13 : 13 + plen]
+        if len(payload) != plen:
+            return None
+        rest = block[13 + plen :]
+        if rest:
+            try:
+                header = pickle.loads(rest)
+            except Exception:
+                header = None  # tolerant: an unreadable header is absent
+            if header is not None:
+                return ("app", uid, payload, header)
+        return ("app", uid, payload)
+    if kind == b"P":
+        try:
+            return pickle.loads(block[1:])
+        except Exception:
+            return None
+    return None
+
+
+def encode_batch(items: Iterable[Tuple[int, bytes]]) -> bytes:
+    """Join (seq, block) pairs into one batch body (magic included)."""
+    parts: List[bytes] = [FB_MAGIC]
+    for seq, block in items:
+        parts.append(_FB_HDR.pack(seq, len(block)))
+        parts.append(block)
+    return b"".join(parts)
+
+
+def decode_batch(body: bytes) -> List[Tuple[int, Optional[tuple]]]:
+    """-> [(seq, inner-frame-or-None)], in stream order.  A mangled tail
+    yields what decoded cleanly; per-block corruption yields (seq, None)
+    so the receiver can account the loss without desyncing the stream."""
+    out: List[Tuple[int, Optional[tuple]]] = []
+    off = len(FB_MAGIC)
+    n = len(body)
+    while off < n:
+        if off + 12 > n:
+            break  # mangled tail: no recoverable frame header
+        seq, blen = _FB_HDR.unpack_from(body, off)
+        off += 12
+        block = body[off : off + blen]
+        off += blen
+        if len(block) != blen:
+            out.append((seq, None))
+            break
+        out.append((seq, decode_block(block)))
+    return out
 
 
 # ------------------------------------------------------------------- #

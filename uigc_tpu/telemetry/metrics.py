@@ -345,6 +345,15 @@ class EventMetricsBridge:
         self._frames_corrupt = r.counter(
             "uigc_frames_corrupt_total", "Frames whose body failed to decode."
         )
+        self._batch_size = r.histogram(
+            "uigc_frame_batch_size",
+            "Frames coalesced per peer-writer flush (runtime/node.py).",
+            buckets=COUNT_BUCKETS,
+        )
+        self._send_failed = r.counter(
+            "uigc_send_failed_total",
+            "Frames lost after sequence assignment (link broke mid-flush).",
+        )
         self._node_down = r.counter(
             "uigc_node_down_total", "Peer-death verdicts, by reason."
         )
@@ -431,6 +440,12 @@ class EventMetricsBridge:
             self._frames_dropped.inc()
         elif name == events.FRAME_CORRUPT:
             self._frames_corrupt.inc()
+        elif name == events.FRAME_BATCH:
+            size = fields.get("size")
+            if size is not None:
+                self._batch_size.observe(size)
+        elif name == events.SEND_FAILED:
+            self._send_failed.inc(kind=fields.get("kind", "?"))
         elif name == events.NODE_DOWN:
             self._node_down.inc(reason=fields.get("reason", "?"))
         elif name == events.NODE_SUSPECT:
@@ -527,6 +542,12 @@ def install_system_gauges(registry: MetricsRegistry, system: Any) -> None:
         "Actor batches waiting for a dispatcher worker.",
         fn=lambda: system.dispatcher.queue_depth(),
     )
+    registry.gauge(
+        "uigc_writer_queue_depth",
+        "Frames queued on the per-peer outbound writer (NodeFabric).",
+        fn=lambda: _writer_depths(system),
+        label_name="peer",
+    )
     # Cluster-sharding gauges: lazy reads of ``system.cluster``, which
     # attaches AFTER telemetry (it needs entity factories) — a callback
     # returning None simply yields no sample until the cluster exists.
@@ -574,6 +595,12 @@ def _transit_depth(system: Any) -> Optional[int]:
     fabric = getattr(system, "fabric", None)
     depth = getattr(fabric, "queue_depth", None)
     return depth() if callable(depth) else None
+
+
+def _writer_depths(system: Any) -> Optional[Dict[str, int]]:
+    fabric = getattr(system, "fabric", None)
+    depths = getattr(fabric, "writer_queue_depths", None)
+    return depths() if callable(depths) else None
 
 
 def _cluster_stat(system: Any, field: str) -> Optional[float]:

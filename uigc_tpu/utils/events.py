@@ -101,6 +101,16 @@ FRAME_CORRUPT = "fabric.frame_corrupt"
 #: a well-known name lookup could not be resolved by the peer's hello
 #: (fields: address, lookup) — see NodeFabric.lookup (runtime/node.py).
 LOOKUP_MISS = "fabric.lookup_miss"
+#: one per-peer writer flush coalesced into a multi-frame batch unit
+#: (fields: dst, size=frames in the batch, bytes=wire bytes) — feeds the
+#: ``uigc_frame_batch_size`` histogram.
+FRAME_BATCH = "fabric.frame_batch"
+#: a frame that had already claimed its sequence number could not reach
+#: the peer (link broke mid-flush, or died while frames were queued);
+#: fields: dst, kind.  The receiver accounts the loss as a gap; this
+#: event is the sender-side record that replaces the old silent
+#: bool-only ``send_frame`` failure path.
+SEND_FAILED = "fabric.send_failed"
 UNDO_FOLD = "crgc.undo_fold"
 
 # Cluster-sharding events (ours; uigc_tpu/cluster).  Emitted by the
