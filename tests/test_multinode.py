@@ -192,6 +192,9 @@ def test_three_node_crash_recovery(with_drops, backend, wire_mode):
     sys_b = make_system("cnodeB", fabric, 3, backend)
     sys_c = make_system("cnodeC", fabric, 3, backend)
     try:
+        # 20s doubles as the regression guard for the idle-wake trace
+        # convoy (collector._graph_dirty): post-fix recovery runs in
+        # 0.6-2.4s; the convoy regime was 18-60s.
         probe = Probe(default_timeout_s=20.0)
 
         holder = sys_c.spawn_root(
@@ -243,6 +246,9 @@ def test_double_crash_quorum_recheck(backend, wire_mode):
     sys_b = make_system("dcB", fabric, 3, backend)
     sys_c = make_system("dcC", fabric, 3, backend)
     try:
+        # 20s doubles as the regression guard for the idle-wake trace
+        # convoy (collector._graph_dirty): post-fix recovery runs in
+        # 0.6-2.4s; the convoy regime was 18-60s.
         probe = Probe(default_timeout_s=20.0)
         holder = sys_c.spawn_root(
             Behaviors.setup_root(lambda ctx: Holder(ctx, probe)), "holder"

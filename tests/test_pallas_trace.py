@@ -48,6 +48,87 @@ def test_pallas_matches_oracle(seed, n, n_edges):
     assert np.array_equal(got, expected)
 
 
+@pytest.mark.parametrize("mode", ["push", "pull", "jump", "auto"])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_trace_modes_match_oracle(seed, mode):
+    """Every propagation strategy (uigc.crgc.trace-mode) must produce
+    oracle-identical marks over graphs with all the semantic wrinkles —
+    the direction-optimizing gates and the pointer jumps are
+    accelerations, never semantics."""
+    rng = np.random.default_rng(seed)
+    g = random_graph(rng, 1500, 6000)
+    expected = trace_ops.trace_marks_np(*g)
+    got = pallas_trace.trace_marks_pallas(*g, mode=mode)
+    assert np.array_equal(got, expected)
+
+
+def test_jump_collapses_chain_sweeps():
+    """The ISSUE-6 acceptance shape: on a long chain (diameter = n) the
+    push fixpoint needs O(n) sweeps while pointer-jumping converges in
+    O(log n) — and both agree with the oracle.  Sweep counts come from
+    the with_stats fixpoint, which is what the wake profiler reports."""
+    n = 200
+    flags = np.full(n, F.FLAG_IN_USE | F.FLAG_INTERNED, dtype=np.uint8)
+    flags[0] |= F.FLAG_ROOT
+    recv = np.zeros(n, dtype=np.int64)
+    sup = np.full(n, -1, dtype=np.int32)
+    src = np.arange(n - 1, dtype=np.int32)
+    dst = np.arange(1, n, dtype=np.int32)
+    w = np.ones(n - 1, dtype=np.int64)
+    expected = trace_ops.trace_marks_np(flags, recv, sup, src, dst, w)
+    prep = pallas_trace.prepare_chunks(src, dst, w, sup, n)
+    jp = pallas_trace.jump_parents_from_graph(src, dst, w, sup, n)
+
+    push_marks, push_stats = pallas_trace.trace_marks_layouts(
+        flags, recv, [prep], mode="push", with_stats=True
+    )
+    jump_marks, jump_stats = pallas_trace.trace_marks_layouts(
+        flags, recv, [prep], mode="jump", jump_parent=jp, with_stats=True
+    )
+    assert np.array_equal(push_marks, expected)
+    assert np.array_equal(jump_marks, expected)
+    push_sweeps = int(push_stats["n_sweeps"])
+    jump_sweeps = int(jump_stats["n_sweeps"])
+    assert push_sweeps >= n - 1  # O(diameter)
+    assert jump_sweeps <= 10  # O(log diameter) at JUMP_STEPS=2
+    assert jump_sweeps * 6 < push_sweeps
+
+
+def test_mode_sweep_counts_at_powerlaw_geometry():
+    """At the benchmark graph model (powerlaw, the 10M-actor geometry's
+    shape at reduced n — sweep counts are hardware-independent and only
+    weakly size-dependent) the jump/auto fixpoint must converge in <=6
+    sweeps where push needs more."""
+    from uigc_tpu.models.graphgen import powerlaw_actor_graph
+
+    n = 1 << 14
+    g = powerlaw_actor_graph(n, seed=0, garbage_fraction=0.5)
+    prep = pallas_trace.prepare_chunks(
+        g["edge_src"].astype(np.int32),
+        g["edge_dst"].astype(np.int32),
+        g["edge_weight"],
+        g["supervisor"],
+        n,
+    )
+    jp = pallas_trace.jump_parents_from_graph(
+        g["edge_src"], g["edge_dst"], g["edge_weight"], g["supervisor"], n
+    )
+    expected = trace_ops.trace_marks_np(
+        g["flags"], g["recv_count"], g["supervisor"],
+        g["edge_src"], g["edge_dst"], g["edge_weight"],
+    )
+    sweeps = {}
+    for mode in ("push", "auto"):
+        marks, stats = pallas_trace.trace_marks_layouts(
+            g["flags"], g["recv_count"], [prep], mode=mode,
+            jump_parent=jp if mode == "auto" else None, with_stats=True,
+        )
+        assert np.array_equal(marks, expected), mode
+        sweeps[mode] = int(stats["n_sweeps"])
+    assert sweeps["auto"] <= 6
+    assert sweeps["auto"] < sweeps["push"]
+
+
 def test_no_edges():
     n = 40
     flags = np.full(n, F.FLAG_IN_USE | F.FLAG_INTERNED, dtype=np.uint8)

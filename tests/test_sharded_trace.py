@@ -49,10 +49,15 @@ def test_sharded_matches_host(graph):
     assert np.array_equal(in_use & ~mark_host, graph["expected_garbage"])
 
 
-@pytest.mark.parametrize("seed", [0, 1])
-def test_sharded_pallas_matches_host(seed):
+@pytest.mark.parametrize(
+    "seed,mode",
+    [(0, "push"), (1, "push"), (0, "pull"), (0, "jump"), (0, "auto")],
+)
+def test_sharded_pallas_matches_host(seed, mode):
     """The per-shard Pallas layout plane (packed base + insert buckets)
-    must agree with the host oracle on the virtual mesh."""
+    must agree with the host oracle on the virtual mesh, under every
+    propagation strategy (jump modes take the replicated jump-parent
+    operand; pull modes skip saturated local supertiles)."""
     import jax
 
     from uigc_tpu.ops import pallas_incremental as pinc
@@ -117,6 +122,14 @@ def test_sharded_pallas_matches_host(seed):
         m,
         sub=meta["sub"],
         group=meta["group"],
+        mode=mode,
+    )
+    from uigc_tpu.ops import pallas_trace as pt
+
+    jump = (
+        (pt.jump_parents(psrc, pdst, n_pad),)
+        if mode in (pt.MODE_JUMP, pt.MODE_AUTO)
+        else ()
     )
     mark = np.asarray(
         traced(
@@ -128,19 +141,25 @@ def test_sharded_pallas_matches_host(seed):
             stacked["emeta"],
             bsrc,
             bdst,
+            *jump,
         )
     )[:n]
     assert np.array_equal(mark, mark_host)
 
 
-def test_sharded_decremental_wakes():
+@pytest.mark.parametrize("mode", ["push", "auto"])
+def test_sharded_decremental_wakes(mode):
     """The closure+repair wake on the virtual mesh: flag churn (halts,
     de-seeding, frees, slots coming alive) and bucket-tier edge churn
     across wakes, each diffed against the from-scratch host oracle.  A
-    zeroed previous state is the cold start."""
+    zeroed previous state is the cold start.  ``auto`` additionally
+    exercises the replicated jump-parent operand maintained across
+    wakes exactly as the mesh backend does (min-fold on insert,
+    invalidate on delete)."""
     import jax
 
     from uigc_tpu.ops import pallas_incremental as pinc
+    from uigc_tpu.ops import pallas_trace as pt
     from uigc_tpu.parallel import (
         make_sharded_decremental_wake,
         pack_shard_layouts,
@@ -183,7 +202,10 @@ def test_sharded_decremental_wakes():
         bucket_m=m,
         sub=meta["sub"],
         group=meta["group"],
+        mode=mode,
     )
+    use_jump = mode in (pt.MODE_JUMP, pt.MODE_AUTO)
+    jp = pt.jump_parents(psrc, pdst, n_pad) if use_jump else None
 
     n_words = n_pad // 32
     zeros_w = np.zeros(n_words, np.int32)
@@ -217,6 +239,7 @@ def test_sharded_decremental_wakes():
             stacked["bmeta1"], stacked["bmeta2"],
             stacked["row_pos"], stacked["emeta"],
             bsrc, bdst,
+            *((jp,) if use_jump else ()),
         )
         mark = np.asarray(out[0])[:n]
         state = [np.asarray(o) for o in out[1:]]
@@ -225,7 +248,7 @@ def test_sharded_decremental_wakes():
     # cold start = full derivation
     assert np.array_equal(run_wake([], []), oracle())
 
-    for wk in range(5):
+    for wk in range(3):
         del_ids, fresh_ids = [], []
         # flag churn
         for _ in range(20):
@@ -253,6 +276,8 @@ def test_sharded_decremental_wakes():
             bcount[sh] = c + 1
             bucket_pairs.append((s_, d_))
             fresh_ids.append(d_)
+            if use_jump and s_ < jp[d_]:  # min-fold, as the mesh backend
+                jp[d_] = s_
         # base-layout deletions via in-place slot masking
         for _ in range(10):
             j = int(rng.integers(0, len(live_pairs)))
@@ -260,10 +285,10 @@ def test_sharded_decremental_wakes():
                 continue
             s_, d_ = live_pairs[j]
             live_pairs[j] = None
+            if use_jump and jp[d_] == s_:  # invalidate, as the mesh backend
+                jp[d_] = n_pad
             sv = int(slot_vals[j])
             sh, ri, col = sv >> 40, (sv >> 8) & 0xFFFFFFFF, sv & 0xFF
-            from uigc_tpu.ops import pallas_trace as pt
-
             stacked["row_pos"][sh, ri, col] = pt._PAD_ROW
             stacked["emeta"][sh, ri, col] = 0
             del_ids.append(d_)

@@ -16,8 +16,19 @@ snapshot is pre-staged), plus a batch of flag/recv scatters (halts,
 busy toggles, recv drains — the seed-churn suspects).  The final chain
 state is cross-checked against the numpy oracle.
 
+``--mode`` selects the repair fixpoint's propagation strategy
+(uigc.crgc.trace-mode: push/pull/jump/auto).  Jump modes stage per-wake
+jump-parent maintenance writes alongside the churn (minimum-fold on
+insert, invalidate-on-remove — exactly the IncrementalPallasLayout
+rules), so the chain exercises the production invariant that a pointer
+never outlives the pair it was built from.  A stats replay (the same
+staged wakes run unchained with the with_stats wake fn) reports the
+per-wake repair sweep counts next to the chain figure, and ``--json``
+dumps the whole result as a BENCH_WAKE-style artifact so the
+sweep-count reduction is regression-tracked.
+
 Usage: python tools/wake_chain_bench.py [--actors N] [--wakes 16]
-       [--churn 20000] [--small]
+       [--churn 20000] [--small] [--mode auto] [--json PATH]
 """
 
 from __future__ import annotations
@@ -41,6 +52,16 @@ def main():
     ap.add_argument("--churn", type=int, default=20_000)
     ap.add_argument("--small", action="store_true")
     ap.add_argument("--no-oracle", action="store_true")
+    ap.add_argument(
+        "--mode", default="auto",
+        choices=["auto", "push", "pull", "jump"],
+        help="repair-fixpoint propagation strategy (uigc.crgc.trace-mode)",
+    )
+    ap.add_argument(
+        "--no-stats", action="store_true",
+        help="skip the per-wake sweep-count replay",
+    )
+    ap.add_argument("--json", default=None, help="dump the result JSON here")
     args = ap.parse_args()
     if args.wakes < 3:
         ap.error("--wakes must be >= 3 (chain(2) is the baseline)")
@@ -85,8 +106,10 @@ def main():
     cap = 1 << max(10, int(K * churn // 2 - 1).bit_length())
     xla = pt.xla_tier([], [], n, cap)
     specs = (pt.layout_spec(prep), pt.layout_spec(xla))
+    mode = args.mode
+    use_jump = mode in (pt.MODE_JUMP, pt.MODE_AUTO)
     wake_raw = pdec.get_wake_fn(
-        n, specs, prep["n_super"], r_rows, prep["s_rows"]
+        n, specs, prep["n_super"], r_rows, prep["s_rows"], mode=mode
     ).raw
 
     # --- pre-stage K wakes of churn ---------------------------------- #
@@ -110,6 +133,13 @@ def main():
     fresh_words = np.zeros((K, r_rows, pt.LANE), np.uint32)
     xsrc = np.full((K, cap), n, np.int32)
     xdst = np.full((K, cap), n, np.int32)
+    # per-wake jump-parent writes (dst -> final value after the wake's
+    # removals invalidate + inserts min-fold); pad index n+2 is OOB of
+    # the (n+1,) parent array, so .set(mode="drop") ignores it
+    jp_now = pt.jump_parents(psrc, pdst, n) if use_jump else None
+    jp0 = jp_now.copy() if use_jump else np.zeros(1, np.int32)
+    jw_idx = np.full((K, churn), n + 2, np.int32)
+    jw_val = np.zeros((K, churn), np.int32)
 
     def set_bits(words, ids):
         ids = np.asarray(ids, np.int64)
@@ -173,6 +203,25 @@ def main():
         xdst[k, :n_ins_total] = [p[1] for p in ins_pairs]
         set_bits(fresh_words[k], [p[1] for p in fresh])
 
+        if use_jump:
+            # Stage this wake's jump-parent maintenance (the
+            # IncrementalPallasLayout rules): a removal invalidates the
+            # pointer built from it, an insert folds in by minimum.
+            aff = []
+            rd, rs = pdst[cand], psrc[cand]
+            hit = jp_now[rd] == rs
+            jp_now[rd[hit]] = n
+            aff.append(rd[hit])
+            if fresh:
+                fs = np.array([p[0] for p in fresh], np.int32)
+                fd = np.array([p[1] for p in fresh], np.int64)
+                prev = jp_now[fd].copy()
+                np.minimum.at(jp_now, fd, fs)
+                aff.append(fd[jp_now[fd] != prev])
+            aff = np.unique(np.concatenate(aff))
+            jw_idx[k, : aff.size] = aff
+            jw_val[k, : aff.size] = jp_now[aff]
+
     dev = {
         "bmeta1": jax.device_put(prep["bmeta1"]),
         "bmeta2": jax.device_put(prep["bmeta2"]),
@@ -190,6 +239,9 @@ def main():
         "flag_vals": jax.device_put(flag_vals),
         "recv_slots": jax.device_put(recv_slots),
         "recv_vals": jax.device_put(recv_vals),
+        "jp0": jax.device_put(jp0),
+        "jw_idx": jax.device_put(jw_idx),
+        "jw_val": jax.device_put(jw_val),
     }
     zeros_w = jnp.zeros((r_rows, pt.LANE), jnp.int32)
 
@@ -198,7 +250,7 @@ def main():
         state0 = (zeros_w,) * 5
 
         def body(k, carry):
-            flags, recv, row_pos, emeta, state = carry
+            flags, recv, row_pos, emeta, jp, state = carry
             # in-chain churn: node-feature scatters + layout slot masks
             flags = flags.at[dev["flag_slots"][k]].set(
                 dev["flag_vals"][k], mode="drop"
@@ -210,12 +262,22 @@ def main():
             cols = dev["mask_cols"][k]
             row_pos = row_pos.at[rows, cols].set(pt._PAD_ROW, mode="drop")
             emeta = emeta.at[rows, cols].set(0, mode="drop")
+            if use_jump:
+                # jump-parent maintenance lands BEFORE the wake, exactly
+                # like the production _sync paths
+                jp = jp.at[dev["jw_idx"][k]].set(
+                    dev["jw_val"][k], mode="drop"
+                )
+                jarg = (jp,)
+            else:
+                jarg = ()
             state = wake_raw(
                 flags,
                 recv,
                 dev["del_w"][k],
                 dev["fresh_w"][k],
                 *state,
+                *jarg,
                 dev["bmeta1"],
                 dev["bmeta2"],
                 row_pos,
@@ -223,10 +285,11 @@ def main():
                 dev["xsrc"][k],
                 dev["xdst"][k],
             )
-            return (flags, recv, row_pos, emeta, state)
+            return (flags, recv, row_pos, emeta, jp, state)
 
-        flags, recv, row_pos, emeta, state = jax.lax.fori_loop(
-            0, k_hi, body, (dev["flags"], dev["recv"], row_pos, emeta, state0)
+        flags, recv, row_pos, emeta, _jp, state = jax.lax.fori_loop(
+            0, k_hi, body,
+            (dev["flags"], dev["recv"], row_pos, emeta, dev["jp0"], state0),
         )
         # data dependency on the final marks
         return jnp.sum(state[0]), state
@@ -238,7 +301,7 @@ def main():
         return time.perf_counter() - t0, state
 
     log = lambda m: print(m, file=sys.stderr, flush=True)
-    log(f"pack {pack_s:.1f}s; compiling chain...")
+    log(f"pack {pack_s:.1f}s; compiling chain (mode={mode})...")
     run(2)  # compile + warmup
     ts = []
     for _ in range(3):
@@ -254,11 +317,61 @@ def main():
         "wakes_chained": K,
         "churn_per_wake": churn,
         "platform": platform,
+        "trace_mode": mode,
         "host_pack_s": round(pack_s, 2),
         "device_per_wake_ms": round(per_wake_ms, 3),
         "target_p50_ms": 10.0,
         "vs_target": round(10.0 / max(per_wake_ms, 1e-9), 4),
     }
+
+    if not args.no_stats:
+        # Per-wake sweep counts: the same staged wakes replayed
+        # UNCHAINED with the with_stats wake fn (device results feed
+        # forward, churn applied host-side from the staged arrays), so
+        # the sweep-count reduction is visible next to the chain figure.
+        log("sweep-count replay...")
+        wake_stats = pdec.get_wake_fn(
+            n, specs, prep["n_super"], r_rows, prep["s_rows"], mode=mode,
+            with_stats=True,
+        )
+        flags_k = flags0.copy()
+        recv_k = recv0.copy()
+        row_pos_h = prep["row_pos"].copy()
+        emeta_h = prep["emeta"].copy()
+        jp_h = jp0.copy()
+        z = np.zeros((r_rows, pt.LANE), np.int32)
+        state_r = tuple(jax.device_put(z) for _ in range(5))
+        sweep_counts = []
+        for k in range(K):
+            fs, ok = flag_slots[k], flag_slots[k] < n
+            flags_k[fs[ok]] = flag_vals[k][ok]
+            rs, ok = recv_slots[k], recv_slots[k] < n
+            recv_k[rs[ok]] = recv_vals[k][ok]
+            mr, ok = mask_rows[k], mask_rows[k] < row_pos_h.shape[0]
+            row_pos_h[mr[ok], mask_cols[k][ok]] = pt._PAD_ROW
+            emeta_h[mr[ok], mask_cols[k][ok]] = 0
+            if use_jump:
+                jw, ok = jw_idx[k], jw_idx[k] <= n
+                jp_h[jw[ok]] = jw_val[k][ok]
+                jarg = (jp_h,)
+            else:
+                jarg = ()
+            out = wake_stats(
+                flags_k, recv_k,
+                del_words[k].view(np.int32), fresh_words[k].view(np.int32),
+                *state_r, *jarg,
+                prep["bmeta1"], prep["bmeta2"], row_pos_h, emeta_h,
+                xsrc[k], xdst[k],
+            )
+            state_r = out[:5]
+            sweep_counts.append(int(out[5]["n_sweeps"]))
+        result["sweep_counts"] = sweep_counts
+        mean_sweeps = statistics.mean(sweep_counts)
+        result["sweeps_mean"] = round(mean_sweeps, 2)
+        result["sweeps_max"] = max(sweep_counts)
+        result["device_per_sweep_ms"] = round(
+            per_wake_ms / max(mean_sweeps, 1e-9), 3
+        )
 
     if not args.no_oracle:
         # oracle on the final state: unpack marks from the chained state
@@ -276,6 +389,9 @@ def main():
         result["oracle_ok"] = bool(np.array_equal(got, expected))
 
     print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(result, fh, indent=2, sort_keys=True)
     if not args.no_oracle and not result["oracle_ok"]:
         sys.exit(1)
 

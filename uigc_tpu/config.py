@@ -50,6 +50,23 @@ DEFAULTS: Dict[str, Any] = {
     "uigc.crgc.shadow-graph": "array",
     # Devices in the mesh backend's mesh; 0 = all visible devices.
     "uigc.crgc.mesh-devices": 0,
+    # Propagation strategy for the device-trace fixpoint (the Pallas
+    # "device"/"decremental"/"mesh*" backends; ops/pallas_trace.py):
+    #   "push" - source-push sweeps over the dirty-chunk frontier (the
+    #            pre-mode behavior; O(diameter) sweeps)
+    #   "pull" - push + destination-pull saturation gates: blocks whose
+    #            output supertile has no unmarked in-use node left are
+    #            skipped outright (dense mid-sweep pruning)
+    #   "jump" - push + pointer-jumping through a min-source parent
+    #            array squared each sweep (O(log diameter) sweeps)
+    #   "auto" - jump always on, pull gates switched per sweep when the
+    #            dirty-chunk density crosses the pull threshold
+    # A config knob so A/B runs (BENCH_TPU_SESSION) need no code edits.
+    "uigc.crgc.trace-mode": "auto",
+    # Dirty-chunk density (fraction of walk chunks dirty) above which
+    # "auto" turns the pull gates on for a sweep; tuned from
+    # tools/sweep_profile.py per-sweep decompositions.
+    "uigc.crgc.pull-density": 0.25,
     # Pipelined collection: the collector dispatches the device wake
     # asynchronously and sweeps the PREVIOUS wake's verdicts while the
     # current one runs, overlapping host ingest with the device trace

@@ -32,6 +32,7 @@ from ...ops import pallas_incremental as pallas_incremental_kinds
 from ...ops import trace as trace_ops
 from ...ops.i64map import I64Map, IntStack
 from ...utils import events
+from ...utils.validation import require
 from . import refob as refob_info
 from .messages import StopMsg, WaveMsg
 from .state import CrgcContext, Entry
@@ -55,10 +56,30 @@ class ArrayShadowGraph:
         use_device: bool = False,
         decremental: bool = False,
         initial_capacity: int = 1024,
+        trace_mode: str = "auto",
+        pull_density: float = 0.25,
     ):
+        from ...ops import pallas_trace as _pt
+
         self.context = context
         self.local_address = local_address
         self.use_device = use_device
+        #: device-trace propagation strategy (uigc.crgc.trace-mode;
+        #: pallas_trace MODE_* docs) + the auto mode's pull threshold
+        require(
+            trace_mode in _pt.TRACE_MODES, "config.trace_mode",
+            "bad uigc.crgc.trace-mode", mode=trace_mode,
+            valid=_pt.TRACE_MODES,
+        )
+        self.trace_mode = trace_mode
+        self.pull_density = pull_density
+        #: collect the per-sweep frontier decomposition (with_stats
+        #: fixpoint + device->host stat readback) this wake.  Set by the
+        #: collector when a wake profiler is attached — the only
+        #: consumer that carries the fields into per-wake records — so
+        #: metrics-only or sanitizer-only telemetry setups never pay
+        #: the stats variant on the wake path.
+        self.sweep_stats = False
         #: per-wake closure+repair detection relative to the previous
         #: fixpoint (ops/pallas_decremental.py) instead of a full
         #: re-trace from seeds; works in interpret mode too, so it is
@@ -763,11 +784,11 @@ class ArrayShadowGraph:
 
     def compute_marks(self) -> np.ndarray:
         if self.use_device:
-            with events.recorder.timed(events.DEVICE_TRACE):
+            with events.recorder.timed(events.DEVICE_TRACE) as ev:
                 if self.decremental:
-                    return self._compute_marks_decremental()
+                    return self._compute_marks_decremental(ev)
                 if self._on_tpu():
-                    return self._compute_marks_pallas()
+                    return self._compute_marks_pallas(ev)
                 return trace_ops.trace_marks_jax(
                     self.flags,
                     self.recv_count,
@@ -806,7 +827,27 @@ class ArrayShadowGraph:
             tpu = self._is_tpu = not pallas_trace.default_interpret()
         return tpu
 
-    def _compute_marks_pallas(self) -> np.ndarray:
+    def _stamp_sweep_stats(self, ev, stats: Optional[dict]) -> None:
+        """Attach the fixpoint's per-sweep frontier decomposition to the
+        enclosing DEVICE_TRACE event — the wake profiler
+        (telemetry/profile.py) carries these fields into its per-wake
+        records, which is where the pull-density threshold is tuned
+        from data (tools/sweep_profile.py reads the same shapes)."""
+        ev.fields["trace_mode"] = self.trace_mode
+        if stats is None:
+            return
+        k = int(stats["n_sweeps"])
+        ev.fields["n_sweeps"] = k
+        k = min(k, len(stats["dirty_chunks"]))
+        ev.fields["sweep_dirty_chunks"] = stats["dirty_chunks"][:k].tolist()
+        if "changed_supers" in stats:
+            ev.fields["sweep_changed_supers"] = (
+                stats["changed_supers"][:k].tolist()
+            )
+        ev.fields["sweep_tiles_skipped"] = stats["tiles_skipped"][:k].tolist()
+        ev.fields["sweep_pull_on"] = stats["pull_on"][:k].tolist()
+
+    def _compute_marks_pallas(self, ev=None) -> np.ndarray:
         """Device trace through the Pallas propagation kernel.
 
         Layout maintenance is incremental (ops/pallas_incremental.py):
@@ -819,9 +860,19 @@ class ArrayShadowGraph:
 
         self._inc = self._sync_layout(
             self._inc,
-            lambda: pallas_incremental.IncrementalPallasLayout(self.capacity),
+            lambda: pallas_incremental.IncrementalPallasLayout(
+                self.capacity,
+                mode=self.trace_mode,
+                pull_density=self.pull_density,
+            ),
             lambda l: l.needs_repack,
         )
+        if ev is not None and self.sweep_stats:
+            marks, stats = self._inc.trace(
+                self.flags, self.recv_count, with_stats=True
+            )
+            self._stamp_sweep_stats(ev, stats)
+            return marks
         return self._inc.trace(self.flags, self.recv_count)
 
     def _sync_layout(self, obj, make, needs_repack) -> object:
@@ -849,15 +900,25 @@ class ArrayShadowGraph:
                 )
         return obj
 
-    def _compute_marks_decremental(self) -> np.ndarray:
+    def _compute_marks_decremental(self, ev=None) -> np.ndarray:
         """Per-wake detection through the decremental tracer: the wake
         cost is proportional to the churn's affected region, not the
         graph (ops/pallas_decremental.py; the steady-state analogue of
         the reference's 50ms incremental collect, LocalGC.scala:144-186,
         at scales where a full re-trace cannot meet the cadence)."""
         self._dec = self._synced_dec()
+        self._dec.collect_stats = ev is not None and self.sweep_stats
         try:
-            return self._dec.marks(self.flags, self.recv_count)
+            marks = self._dec.marks(self.flags, self.recv_count)
+            if self._dec.collect_stats:
+                ls = self._dec.last_stats
+                self._stamp_sweep_stats(
+                    ev,
+                    None if ls is None else {
+                        k: np.asarray(v) for k, v in ls.items()
+                    },
+                )
+            return marks
         except Exception:
             # A poisoned async result surfaces at the readback inside
             # marks(), after the tracer committed state; drop it so the
@@ -896,7 +957,11 @@ class ArrayShadowGraph:
 
         self._dec = self._sync_layout(
             self._dec,
-            lambda: pallas_decremental.DecrementalTracer(self.capacity),
+            lambda: pallas_decremental.DecrementalTracer(
+                self.capacity,
+                mode=self.trace_mode,
+                pull_density=self.pull_density,
+            ),
             lambda d: d.layout.needs_repack,
         )
         return self._dec

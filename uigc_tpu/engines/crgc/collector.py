@@ -130,6 +130,17 @@ class Bookkeeper(RawBehavior):
         self.started = False
         self._timer_keys: list = []
         self.shadow_graph = engine.make_shadow_graph()
+        #: does the shadow graph hold mutations the last trace has not
+        #: seen?  Set by every fold path (entries, packed rows, deltas,
+        #: undo folds, wave starts); cleared when a trace runs.  A wake
+        #: that folded nothing skips the trace outright — the verdict
+        #: is a pure function of graph state, so re-deriving it idle is
+        #: pure cost (at mesh scale a no-op wake otherwise pays a full
+        #: collective program dispatch, saturating the collector and
+        #: convoying every other system on the process-wide collective
+        #: lock, which is what stretched crash-recovery quorums from
+        #: ms to tens of seconds).
+        self._graph_dirty = True
         # Multi-node state (reference: LocalGC.scala:59-67).
         self.remote_gcs: Dict[str, Any] = {}  # address -> peer Bookkeeper cell
         self.undo_logs: Dict[str, UndoLog] = {}
@@ -187,6 +198,7 @@ class Bookkeeper(RawBehavior):
                 self.collect()
         elif isinstance(msg, _StartWave):
             self.shadow_graph.start_wave()
+            self._graph_dirty = True
         elif isinstance(msg, _FinalizeEgresses):
             # (reference: LocalGC.scala:219-224, via ForwardToEgress)
             fabric = self.engine.system.fabric
@@ -254,6 +266,7 @@ class Bookkeeper(RawBehavior):
             with events.recorder.timed(events.MERGING_DELTA_GRAPHS):
                 # Only merge from nodes that have not been removed.
                 self.shadow_graph.merge_delta(graph)
+                self._graph_dirty = True
                 self.undo_logs[graph.address].merge_delta_graph(graph)
 
     def handle_local_ingress_entry(self, entry: IngressEntry) -> None:
@@ -300,6 +313,9 @@ class Bookkeeper(RawBehavior):
             )
             self.shadow_graph.merge_undo_log(log)
             self.shadow_graph.trace(should_kill=True)
+            # The fold's own trace consumed the merge, but its kills
+            # cascade; leave the next timer wake a fresh derivation.
+            self._graph_dirty = True
 
     # ------------------------------------------------------------- #
     # Collection (reference: LocalGC.scala:144-196)
@@ -318,6 +334,11 @@ class Bookkeeper(RawBehavior):
         tracer = tel.tracer if tel is not None and tel.tracer.enabled else None
         prof = engine.wake_profiler
         wake = prof.begin_wake() if prof is not None else None
+        if hasattr(self.shadow_graph, "sweep_stats"):
+            # Device backends collect the per-sweep frontier stats only
+            # when a profiler is attached to carry them (arrays.py
+            # _stamp_sweep_stats -> WakeProfiler per-wake records).
+            self.shadow_graph.sweep_stats = wake is not None
         count = n_garbage = 0
         try:
             if tracer is not None:
@@ -386,6 +407,8 @@ class Bookkeeper(RawBehavior):
                 self.finalize_delta_graph(wake)
             ev.fields["num_entries"] = count
         self.total_entries += count
+        if count:
+            self._graph_dirty = True
         graph = self.shadow_graph
         with _phase(wake, "trace"):
             if self.engine.pipelined and getattr(graph, "can_pipeline", False):
@@ -404,8 +427,17 @@ class Bookkeeper(RawBehavior):
                         max(30.0, self.engine.wakeup_interval_ms / 1000.0 * 20)
                     )
                 graph.launch_trace()
-            else:
+            elif self._graph_dirty:
+                # Cleared before the trace: kills the sweep triggers
+                # re-dirty through their death-flush entries (and
+                # _after_wake re-wakes on progress), so cascades still
+                # converge wake by wake.
+                self._graph_dirty = False
                 n_garbage = graph.trace(should_kill=True)
+            else:
+                # Nothing folded since the last trace — the verdict
+                # cannot have changed; skip the device round-trip.
+                n_garbage = 0
         return count, n_garbage
 
     def _after_wake(self, n_garbage: int) -> None:

@@ -103,6 +103,10 @@ class CRGC(Engine):
                 self.system.address,
                 use_device=(self.shadow_graph_impl in ("device", "decremental")),
                 decremental=(self.shadow_graph_impl == "decremental"),
+                trace_mode=self.system.config.get_string("uigc.crgc.trace-mode"),
+                pull_density=self.system.config.get_float(
+                    "uigc.crgc.pull-density"
+                ),
             )
         elif self.shadow_graph_impl == "native":
             from ...native import NativeShadowGraph
@@ -116,6 +120,10 @@ class CRGC(Engine):
                 self.system.address,
                 n_devices=self.system.config.get_int("uigc.crgc.mesh-devices"),
                 decremental=(self.shadow_graph_impl == "mesh-decremental"),
+                trace_mode=self.system.config.get_string("uigc.crgc.trace-mode"),
+                pull_density=self.system.config.get_float(
+                    "uigc.crgc.pull-density"
+                ),
             )
         raise ValueError(f"bad shadow-graph impl {self.shadow_graph_impl!r}")
 

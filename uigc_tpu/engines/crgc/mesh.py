@@ -92,12 +92,16 @@ class MeshShadowGraph(ArrayShadowGraph):
         n_devices: int = 0,
         initial_capacity: int = 1024,
         decremental: bool = False,
+        trace_mode: str = "auto",
+        pull_density: float = 0.25,
     ):
         super().__init__(
             context,
             local_address,
             use_device=True,
             initial_capacity=initial_capacity,
+            trace_mode=trace_mode,
+            pull_density=pull_density,
         )
         import jax
 
@@ -119,6 +123,15 @@ class MeshShadowGraph(ArrayShadowGraph):
         from ...ops import pallas_trace as pt
 
         self.s_rows = pt.S_ROWS
+        #: jump/auto trace modes jump marks through a REPLICATED
+        #: min-source parent array (every shard runs the same pointer
+        #: doubling over replicated tables — no collective needed);
+        #: maintained O(churn) from the raw pair log like the
+        #: single-device IncrementalPallasLayout.jump_parent
+        self._use_jump = trace_mode in (pt.MODE_JUMP, pt.MODE_AUTO)
+        self._jump_parent: Optional[np.ndarray] = None
+        self._jump_writes: Dict[int, int] = {}
+        self._jump_dev = None
 
         # device state (built lazily on first trace)
         self._dev_ready = False
@@ -169,7 +182,8 @@ class MeshShadowGraph(ArrayShadowGraph):
         layouts sync mesh-natively first; state commits at dispatch
         (like DecrementalTracer.wake_device), so a pending wake
         discarded by a synchronous trace loses nothing."""
-        with events.recorder.timed(events.DEVICE_TRACE):
+        with events.recorder.timed(events.DEVICE_TRACE) as ev:
+            ev.fields["trace_mode"] = self.trace_mode
             self._sync_device()
             self.stats["wakes"] += 1
             with _MESH_COLLECTIVE_LOCK:
@@ -190,6 +204,8 @@ class MeshShadowGraph(ArrayShadowGraph):
             self._bucket_m,
             meta["sub"],
             meta["group"],
+            self.trace_mode,
+            self.pull_density,
             tuple(d.id for d in self.mesh.devices.flat),
             self.mesh.axis_names,
         )
@@ -242,6 +258,12 @@ class MeshShadowGraph(ArrayShadowGraph):
             pack_keys(esrc, edst, kinds), slot_vals
         )
         self._mask_writes = []
+        if self._use_jump:
+            from ...ops import pallas_trace as pt
+
+            self._jump_parent = pt.jump_parents(esrc, edst, n_pad)
+            self._jump_writes = {}
+            self._jump_dev = None  # re-uploaded (replicated) on first sync
 
         # --- empty insert buckets --------------------------------- #
         # Sized so the bucket tier absorbs a meaningful fraction of the
@@ -298,6 +320,19 @@ class MeshShadowGraph(ArrayShadowGraph):
         Batched like IncrementalPallasLayout.apply_log (the net-effect
         argument and anomaly accounting live in slotmap.fold_log): slot
         lookups are one vectorized binary search per batch."""
+        if self._use_jump:
+            # Batched jump-parent maintenance — the same
+            # pt.fold_jump_log rules as the single-device layout plane
+            # (min-fold on insert, invalidate-on-remove, conservative
+            # about pairs both inserted and removed in one batch), so
+            # the backends cannot diverge on which edges the jump
+            # sweep may cross.
+            from ...ops import pallas_trace as pt
+
+            pt.fold_jump_log(
+                self._jump_parent, self._pair_log, self._n_pad,
+                self._jump_writes,
+            )
         removes, cond_removes, inserts = fold_log(self._pair_log)
         if self.decremental:
             # Suspect bookkeeping for the decremental wake: removal
@@ -390,6 +425,41 @@ class MeshShadowGraph(ArrayShadowGraph):
             fn = self._jit_cache[name] = builder()
         return fn
 
+    def _sync_jump_mirror(self) -> None:
+        """Replicated jump-parent device mirror: full upload once per
+        rebuild, O(churn) scatter after (same policy as the node
+        arrays; replicated because the pointer doubling gathers
+        globally on every shard)."""
+        if not self._use_jump:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._jump_dev is None:
+            repl = NamedSharding(self.mesh, P())
+            self._jump_dev = jax.device_put(self._jump_parent, repl)
+            self._jump_writes = {}
+        elif self._jump_writes:
+            w = self._jump_writes
+            self._jump_writes = {}
+            k = len(w)
+            kp = max(_SINK_PAD, _pow2(k))
+            idx = np.full(kp, self._n_pad + 1, np.int32)  # OOB -> drop
+            vals = np.zeros(kp, np.int32)
+            idx[:k] = np.fromiter(w.keys(), np.int64, k)
+            vals[:k] = np.fromiter(w.values(), np.int64, k)
+
+            def build_jump():
+                @partial(jax.jit, donate_argnums=(0,))
+                def apply_jump(jp, idx, vals):
+                    return jp.at[idx].set(vals, mode="drop")
+
+                return apply_jump
+
+            self._jump_dev = self._jit("jump", build_jump)(
+                self._jump_dev, idx, vals
+            )
+
     def _sync_device(self) -> None:
         if (
             not self._dev_ready
@@ -397,10 +467,12 @@ class MeshShadowGraph(ArrayShadowGraph):
             or self._n_pad < self.capacity
         ):
             self._full_rebuild()
+            self._sync_jump_mirror()
             return
         pair_writes = self._apply_pair_log() if self._pair_log else []
         if pair_writes is None:
             self._full_rebuild()
+            self._sync_jump_mirror()
             return
         import jax
         import jax.numpy as jnp
@@ -489,6 +561,8 @@ class MeshShadowGraph(ArrayShadowGraph):
                 self._dev_flags, self._dev_recv, lslot, rdelta, fset, fclear
             )
 
+        self._sync_jump_mirror()
+
     # ------------------------------------------------------------- #
     # Trace
     # ------------------------------------------------------------- #
@@ -517,7 +591,8 @@ class MeshShadowGraph(ArrayShadowGraph):
         return jax.device_put(words.view(np.int32), nodes_s)
 
     def compute_marks(self) -> np.ndarray:
-        with events.recorder.timed(events.DEVICE_TRACE):
+        with events.recorder.timed(events.DEVICE_TRACE) as ev:
+            ev.fields["trace_mode"] = self.trace_mode
             self._sync_device()
             self.stats["wakes"] += 1
             meta = self._layout_meta
@@ -539,8 +614,11 @@ class MeshShadowGraph(ArrayShadowGraph):
                     self._bucket_m,
                     sub=meta["sub"],
                     group=meta["group"],
+                    mode=self.trace_mode,
+                    pull_density=self.pull_density,
                 ),
             )
+            jump = (self._jump_dev,) if self._use_jump else ()
             with _MESH_COLLECTIVE_LOCK:
                 mark = traced(
                     self._dev_flags,
@@ -551,6 +629,7 @@ class MeshShadowGraph(ArrayShadowGraph):
                     self._dev_stacked["emeta"],
                     self._dev_psrc,
                     self._dev_pdst,
+                    *jump,
                 )
                 return np.asarray(mark)[: self.capacity]
 
@@ -577,6 +656,8 @@ class MeshShadowGraph(ArrayShadowGraph):
                 self._bucket_m,
                 sub=meta["sub"],
                 group=meta["group"],
+                mode=self.trace_mode,
+                pull_density=self.pull_density,
             ),
         )
         if self._wake_state is None:
@@ -587,6 +668,7 @@ class MeshShadowGraph(ArrayShadowGraph):
             self._wake_state = [z] * 5
         del_w = self._word_array(self._pending_del_dst)
         fresh_w = self._word_array(self._pending_fresh_dst)
+        jump = (self._jump_dev,) if self._use_jump else ()
         out = wake(
             self._dev_flags,
             self._dev_recv,
@@ -599,6 +681,7 @@ class MeshShadowGraph(ArrayShadowGraph):
             self._dev_stacked["emeta"],
             self._dev_psrc,
             self._dev_pdst,
+            *jump,
         )
         self._wake_state = list(out[1:])
         self._pending_del_dst.clear()

@@ -53,6 +53,7 @@ import numpy as np
 
 from . import pallas_trace as pt
 from . import trace as trace_ops
+from ..utils.validation import require
 from .pallas_incremental import IncrementalPallasLayout
 
 _fn_cache: Dict[tuple, object] = {}
@@ -65,14 +66,33 @@ def _build_wake_fn(
     r_rows: int,
     s_rows: int,
     interpret: bool,
+    mode: str = pt.MODE_PUSH,
+    pull_density: float = pt.DEFAULT_PULL_DENSITY,
+    with_stats: bool = False,
 ):
     """The jitted wake: (flags, recv, del_words, fresh_words, prev
-    state, *layout args) -> (mark_w, seed_w, halted_w, iu_w, table) with
-    all word tables (r_rows, LANE) int32 device arrays."""
+    state, [jump parents,] *layout args) -> (mark_w, seed_w, halted_w,
+    iu_w, table) with all word tables (r_rows, LANE) int32 device
+    arrays.
+
+    ``mode`` applies to the REPAIR fixpoint only (pallas_trace MODE_*
+    docs): on a cold start the repair IS the full derivation, which is
+    where the O(diameter) sweep wall lives.  The closure phase stays a
+    plain push fixpoint: it is bounded by the churn's region (usually
+    shallow), and jump hits there would only over-approximate the
+    closure — sound but more re-derivation for nothing.  ``with_stats``
+    appends a per-wake stats dict (repair sweep count + per-sweep
+    frontier decomposition) to the returned tuple."""
     import jax
     import jax.numpy as jnp
 
     F = trace_ops
+    require(
+        mode in pt.TRACE_MODES, "config.trace_mode",
+        "bad trace mode", mode=mode, valid=pt.TRACE_MODES,
+    )
+    use_jump = mode in (pt.MODE_JUMP, pt.MODE_AUTO)
+    use_pull = mode in (pt.MODE_PULL, pt.MODE_AUTO)
 
     geoms = {spec[-2:] for spec in specs if spec[0] != "xla"}
     assert len(geoms) == 1, "packed layouts must share (sub, group)"
@@ -89,10 +109,15 @@ def _build_wake_fn(
     n_pad_nodes = n_super * s_rows * pt.LANE
     t_rows = n_super * s_rows
     sup_words = s_rows * (pt.LANE // pt.WORD_BITS)  # words per supertile
+    pull_cut = max(1, int(round(pull_density * n_chunks)))
 
     def wake_fn(flags, recv_count, del_w, fresh_w, prev_mark_w,
                 prev_seed_w, prev_halted_w, prev_iu_w, prev_table,
-                *layout_args):
+                *rest):
+        if use_jump:
+            jump_j0, *layout_args = rest
+        else:
+            jump_j0, layout_args = None, rest
         in_use = (flags & F.FLAG_IN_USE) != 0
         halted = (flags & F.FLAG_HALTED) != 0
         seed = (
@@ -114,12 +139,10 @@ def _build_wake_fn(
             specs, gated, n, n_super, s_rows, jnp
         )
 
-        def contribs(table, d, l, suspect_g, use_gate):
+        def contribs(table, d, l, gate):
             """One propagation sweep over every layout (shared loop:
-            pallas_trace.build_sweep_contribs).  The gate vector is
-            zeroed when use_gate is False, which makes the dst-gated
-            kernels behave exactly like the plain ones."""
-            gate = jnp.where(use_gate, suspect_g, jnp.zeros_like(suspect_g))
+            pallas_trace.build_sweep_contribs); a zero gate vector makes
+            the dst-gated kernels behave exactly like the plain ones."""
             return gated_sweep(table, d, l, layout_args, gate=gate)
 
         iu_w = pack(in_use)
@@ -144,11 +167,11 @@ def _build_wake_fn(
         def c_cond(carry):
             return carry[-1]
 
+        zero_gate = jnp.zeros((n_super,), jnp.int32)
+
         def c_body(carry):
             closure_w, d, l, _ = carry
-            hits2d = contribs(
-                closure_w, d, l, jnp.zeros((n_super,), jnp.int32), False
-            )
+            hits2d = contribs(closure_w, d, l, zero_gate)
             hit_w = pt.pack_hits_table(hits2d, r_rows, jnp)
             new_closure = closure_w | (hit_w & prev_mark_w)
             d2, l2, changed = dirty_chunks(new_closure, closure_w)
@@ -187,29 +210,86 @@ def _build_wake_fn(
         mark_w0 = (prev_mark_w & ~closure_w) | seed_w
         table0 = mark_w0 & nh_w
         rd0, rl0, rchanged0 = dirty_chunks(table0, prev_table)
+        trans_w = iu_w & nh_w  # jump-transparent intermediates
 
         def r_cond(carry):
-            return carry[-1]
+            return carry["changed"]
 
         def r_body(carry):
-            mark_w, table, d, l, use_gate, _ = carry
-            hits2d = contribs(table, d, l, suspect_g, use_gate)
+            mark_w, table = carry["mark"], carry["table"]
+            d, l = carry["d"], carry["l"]
+            n_dirty = d[n_chunks]
+            # Gate composition: the repair forcing (GATE_FULL on suspect
+            # tiles, first sweep only) under the pull skip (GATE_SKIP on
+            # saturated tiles — a saturated tile has nothing left to
+            # re-derive, contributions are not carried across sweeps).
+            base_gate = jnp.where(carry["use_gate"], suspect_g, zero_gate)
+            if use_pull:
+                sat = pt.saturated_tiles(
+                    mark_w, iu_w, n_super, sup_words, jnp
+                )
+                if mode == pt.MODE_AUTO:
+                    pull_on = n_dirty >= pull_cut
+                else:
+                    pull_on = jnp.array(True)
+                gate = jnp.where(pull_on & (sat > 0), pt.GATE_SKIP,
+                                 base_gate)
+            else:
+                sat = None
+                pull_on = jnp.array(False)
+                gate = base_gate
+            hits2d = contribs(table, d, l, gate)
             hit_w = pt.pack_hits_table(hits2d, r_rows, jnp)
             new_mark_w = mark_w | (hit_w & iu_w)
+            if use_jump:
+                jh, jump_j = pt.jump_sweep(
+                    table, carry["jump"], trans_w, n, jnp
+                )
+                new_mark_w = new_mark_w | (pack(jh) & iu_w)
             new_table = new_mark_w & nh_w
             d2, l2, changed = dirty_chunks(new_table, table)
             # The gated sweep fully re-derives suspect supertiles; the
             # monotone dirty machinery is sufficient (and cheaper) after.
-            return new_mark_w, new_table, d2, l2, jnp.array(False), changed
+            out = dict(carry, mark=new_mark_w, table=new_table, d=d2,
+                       l=l2, use_gate=jnp.array(False), changed=changed)
+            if use_jump:
+                out["jump"] = jump_j
+            if with_stats:
+                i = jnp.minimum(carry["sweep_i"], pt.MAX_SWEEP_STATS - 1)
+                out["sweep_i"] = carry["sweep_i"] + 1
+                out["st_dirty"] = carry["st_dirty"].at[i].set(n_dirty)
+                if use_pull:
+                    out["st_skip"] = carry["st_skip"].at[i].set(
+                        jnp.where(pull_on, sat.sum(), 0)
+                    )
+                    out["st_pull"] = carry["st_pull"].at[i].set(
+                        pull_on.astype(jnp.int32)
+                    )
+            return out
 
         # Run at least one gated sweep whenever anything is suspect,
         # even if the table diff alone is empty.
         run0 = rchanged0 | (suspect_g.sum() > 0)
-        mark_w, table, _, _, _, _ = jax.lax.while_loop(
-            r_cond,
-            r_body,
-            (mark_w0, table0, rd0, rl0, jnp.array(True), run0),
-        )
+        carry0 = {"mark": mark_w0, "table": table0, "d": rd0, "l": rl0,
+                  "use_gate": jnp.array(True), "changed": run0}
+        if use_jump:
+            carry0["jump"] = jump_j0.astype(jnp.int32)
+        if with_stats:
+            zero_stats = jnp.zeros((pt.MAX_SWEEP_STATS,), jnp.int32)
+            carry0.update(
+                sweep_i=jnp.zeros((), jnp.int32), st_dirty=zero_stats,
+                st_skip=zero_stats, st_pull=zero_stats,
+            )
+        out = jax.lax.while_loop(r_cond, r_body, carry0)
+        mark_w, table = out["mark"], out["table"]
+        if with_stats:
+            stats = {
+                "n_sweeps": out["sweep_i"],
+                "dirty_chunks": out["st_dirty"],
+                "tiles_skipped": out["st_skip"],
+                "pull_on": out["st_pull"],
+            }
+            return mark_w, seed_w, halted_w, iu_w, table, stats
         return mark_w, seed_w, halted_w, iu_w, table
 
     jitted = jax.jit(wake_fn)
@@ -217,7 +297,9 @@ def _build_wake_fn(
     return jitted
 
 
-def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None):
+def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None,
+                mode=pt.MODE_PUSH, pull_density=pt.DEFAULT_PULL_DENSITY,
+                with_stats=False):
     """Cached jitted wake fn; its ``raw`` attribute is the unjitted body
     for callers that compose wakes inside a larger program (the chained
     wake benchmark scans K of them in one jit)."""
@@ -226,11 +308,15 @@ def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None):
     # _int8_mxu in the key: the flag is read at kernel build time, so
     # flipping UIGC_KERNEL_INT8 between runs A/Bs both datapaths in one
     # process instead of requiring a restart per arm.
-    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret, pt._int8_mxu())
+    key = (
+        n, tuple(specs), n_super, r_rows, s_rows, interpret,
+        pt._int8_mxu(), mode, pull_density, with_stats,
+    )
     fn = _fn_cache.get(key)
     if fn is None:
         fn = _fn_cache[key] = _build_wake_fn(
-            n, tuple(specs), n_super, r_rows, s_rows, interpret
+            n, tuple(specs), n_super, r_rows, s_rows, interpret,
+            mode=mode, pull_density=pull_density, with_stats=with_stats,
         )
     return fn
 
@@ -249,6 +335,12 @@ class DecrementalTracer:
         self.layout = IncrementalPallasLayout(n, interpret=interpret, **kwargs)
         self.n = n
         self.interpret = interpret
+        #: when set, each wake runs the with_stats variant of the wake
+        #: fn and leaves the repair fixpoint's per-sweep frontier
+        #: decomposition (device arrays, read back lazily) in
+        #: ``last_stats`` for the wake profiler
+        self.collect_stats = False
+        self.last_stats: Optional[dict] = None
         self._mark_w = None
         self._seed_w = None
         self._halted_w = None
@@ -334,6 +426,9 @@ class DecrementalTracer:
             r_rows,
             first["s_rows"],
             self.interpret,
+            mode=self.layout.mode,
+            pull_density=self.layout.pull_density,
+            with_stats=self.collect_stats,
         )
         if self._mark_w is None or self._mark_w.shape[0] != r_rows:
             z = jax.device_put(np.zeros((r_rows, pt.LANE), np.int32))
@@ -362,6 +457,8 @@ class DecrementalTracer:
         # must invalidate() (the previous fixpoint is lost with the
         # device state anyway), which makes the next wake a full
         # re-derivation and the drained suspects irrelevant.
+        if self.collect_stats:
+            *out, self.last_stats = out
         self._mark_w, self._seed_w, self._halted_w, self._iu_w, self._table = out
         self._pending_del_dst.clear()
         self._pending_fresh_dst.clear()

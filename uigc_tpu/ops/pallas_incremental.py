@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from . import pallas_trace as pt
+from ..utils.validation import require
 from .slotmap import PackedSlotMap, fold_log, pack_key, pack_keys, unpack_keys
 
 #: pair kinds
@@ -72,9 +73,19 @@ class IncrementalPallasLayout:
         interpret: Optional[bool] = None,
         sub: Optional[int] = None,
         group: Optional[int] = None,
+        mode: str = pt.MODE_AUTO,
+        pull_density: float = pt.DEFAULT_PULL_DENSITY,
     ):
         self.n = n
         self.s_rows = s_rows
+        #: propagation strategy (pallas_trace MODE_*, uigc.crgc.trace-mode)
+        require(
+            mode in pt.TRACE_MODES, "config.trace_mode",
+            "bad trace mode", mode=mode, valid=pt.TRACE_MODES,
+        )
+        self.mode = mode
+        self.pull_density = pull_density
+        self.use_jump = mode in (pt.MODE_JUMP, pt.MODE_AUTO)
         # Pin the kernel walk geometry once: base and delta tiers must
         # agree (they share one trace), and a mid-life platform change
         # must not silently mix geometries.  Explicit sub/group override
@@ -104,6 +115,18 @@ class IncrementalPallasLayout:
         self.masked_base = 0
         self.masked_frozen = 0
         self._xla_cap = 1 << 10
+        #: min-source jump-parent array (n + 1,) for the jump/auto trace
+        #: modes.  Invariant: jump_parent[d] is always a CURRENT live
+        #: pair's source (or the sentinel n) — a stale pointer would let
+        #: the jump sweep propagate marks across a deleted edge.
+        #: Maintained O(1) per mutation: inserts fold in by minimum,
+        #: removing the pair a pointer was built from invalidates it
+        #: (best-effort: the next insert or rebuild re-derives).
+        self.jump_parent = np.full(n + 1, n, dtype=np.int32)
+        #: queued jump-parent device writes (dst -> final host value;
+        #: last-wins dedup keeps the device scatter order-independent)
+        self._jump_writes: Dict[int, int] = {}
+        self._jump_dev = None
         self.stats = {
             "rebuilds": 0,
             "freezes": 0,
@@ -156,6 +179,10 @@ class IncrementalPallasLayout:
             sub=self.sub,
             group=self.group,
         )
+        if self.use_jump:
+            self.jump_parent = pt.jump_parents(psrc, pdst, self.n)
+        self._jump_writes.clear()
+        self._jump_dev = None
         slot_ri = self.base.pop("slot_ri")
         slot_col = self.base.pop("slot_col")
         self.base_slot = PackedSlotMap(
@@ -234,8 +261,31 @@ class IncrementalPallasLayout:
     # Mutation (O(1) per changed pair)
     # ----------------------------------------------------------------- #
 
+    def _jump_insert(self, src: int, dst: int) -> None:
+        """Fold a new live pair into the jump-parent array (minimum
+        wins, see jump_parents); O(1), queued for the device mirror."""
+        if not self.use_jump or dst >= self.n or src >= self.n:
+            return
+        if src < self.jump_parent[dst]:
+            self.jump_parent[dst] = src
+            if self._jump_dev is not None:
+                self._jump_writes[dst] = src
+
+    def _jump_remove(self, src: int, dst: int) -> None:
+        """Invalidate the jump parent if it was built from this pair.
+        Conservative: another live pair with the same (src, dst) node
+        ids (the other kind) may remain, but a spurious invalidation
+        only costs acceleration, never soundness."""
+        if not self.use_jump or dst >= self.n:
+            return
+        if self.jump_parent[dst] == src:
+            self.jump_parent[dst] = self.n
+            if self._jump_dev is not None:
+                self._jump_writes[dst] = self.n
+
     def insert(self, src: int, dst: int, kind: int) -> None:
         key = pack_key(src, dst, kind)
+        self._jump_insert(src, dst)
         if key in self.pending or key in self.frozen_slot or key in self.base_slot:
             # The graph layer only reports dead->live transitions, so a
             # duplicate means caller-side accounting drift; the pair is
@@ -255,6 +305,7 @@ class IncrementalPallasLayout:
 
     def remove(self, src: int, dst: int, kind: int) -> None:
         key = pack_key(src, dst, kind)
+        self._jump_remove(src, dst)
         if key in self.pending:
             del self.pending[key]
             return
@@ -318,6 +369,16 @@ class IncrementalPallasLayout:
         base-slot lookups are one vectorized binary search for the whole
         batch instead of a scalar search per pair (slotmap.fold_log
         documents the net-effect argument)."""
+        if self.use_jump:
+            # Batched jump-parent maintenance (pt.fold_jump_log):
+            # conservative about insert-and-remove-in-one-batch pairs,
+            # so an insert-then-remove of the pair a pointer came from
+            # always leaves it invalidated, exactly as sequential
+            # insert()/remove() calls would.
+            pt.fold_jump_log(
+                self.jump_parent, log, self.n,
+                self._jump_writes if self._jump_dev is not None else None,
+            )
         removes, cond_removes, inserts = fold_log(log)
 
         base_rem: List[int] = []
@@ -406,10 +467,13 @@ class IncrementalPallasLayout:
             preps.append(pt.xla_tier(psrc, pdst, self.n, self._xla_cap))
         return preps
 
-    def trace(self, flags, recv_count) -> np.ndarray:
+    def trace(self, flags, recv_count, with_stats: bool = False):
         preps = self.prepare_wake()
         return pt.trace_marks_layouts(
-            flags, recv_count, preps, interpret=self.interpret
+            flags, recv_count, preps, interpret=self.interpret,
+            mode=self.mode, pull_density=self.pull_density,
+            jump_parent=self.jump_parent if self.use_jump else None,
+            with_stats=with_stats,
         )
 
     # ----------------------------------------------------------------- #
@@ -478,13 +542,46 @@ class IncrementalPallasLayout:
             out.append(mirror["super_ids"])
         return out
 
+    def jump_device(self):
+        """The device-resident jump-parent mirror, synced with the
+        queued host writes (an O(churn) scatter, like the masked-slot
+        mirrors — the parent array never re-uploads per wake)."""
+        import jax
+
+        if self._jump_dev is None:
+            self._jump_dev = jax.device_put(self.jump_parent)
+            self._jump_writes.clear()
+        elif self._jump_writes:
+            import jax.numpy as jnp
+            from functools import partial
+
+            if getattr(self, "_jump_scatter", None) is None:
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def _jscatter(jp, idx, vals):
+                    return jp.at[idx].set(vals, mode="drop")
+
+                self._jump_scatter = _jscatter
+            k = len(self._jump_writes)
+            kp = 1 << max(6, int(k - 1).bit_length())
+            idx = np.full(kp, self.n + 1, dtype=np.int32)  # pad = dropped
+            vals = np.zeros(kp, dtype=np.int32)
+            idx[:k] = np.fromiter(self._jump_writes.keys(), np.int64, k)
+            vals[:k] = np.fromiter(self._jump_writes.values(), np.int64, k)
+            self._jump_dev = self._jump_scatter(self._jump_dev, idx, vals)
+            self._jump_writes.clear()
+        return self._jump_dev
+
     def prepare_device_wake(self):
         """prepare_wake + device-operand assembly + mirror GC: the
         device-resident wake entry shared by :meth:`trace_device` and the
         decremental tracer (ops/pallas_decremental.py).  Returns
-        (preps, args)."""
+        (preps, args) with the jump-parent mirror leading ``args`` for
+        jump/auto-mode layouts."""
         preps = self.prepare_wake()
         args = []
+        if self.use_jump:
+            args.append(self.jump_device())
         for p in preps:
             args.extend(self._device_args(p))
         live_tokens = {
@@ -510,5 +607,7 @@ class IncrementalPallasLayout:
             preps[0]["r_rows"],
             preps[0]["s_rows"],
             self.interpret,
+            mode=self.mode,
+            pull_density=self.pull_density,
         )
         return fn(flags_dev, recv_dev, *args)

@@ -62,6 +62,7 @@ from typing import Dict
 import numpy as np
 
 from . import trace as trace_ops
+from ..utils.validation import require
 
 LANE = 128  # lanes per vreg row
 ROWS = 8  # sublane rows per edge-slot sub-block (slot row = src row mod 8)
@@ -99,6 +100,203 @@ _BLOCK_QUANTUM = 8192
 #: bump when prepare_pairs' output format changes (layout caches key on
 #: it; tools/sweep_profile.py persists packed layouts across runs)
 PACK_FORMAT_VERSION = 2
+
+# --------------------------------------------------------------------- #
+# Trace propagation modes (uigc.crgc.trace-mode)
+# --------------------------------------------------------------------- #
+#: plain source-push sweeps over the dirty-chunk frontier (the pre-mode
+#: behavior; every other mode is a strict superset of its propagation).
+MODE_PUSH = "push"
+#: push walks + destination-pull saturation gates every sweep: blocks
+#: whose output supertile has no unmarked in-use node left are skipped
+#: outright (GraphACT's push-vs-pull density asymmetry, PAPERS.md).
+MODE_PULL = "pull"
+#: push walks + pointer-jumping: marks additionally jump through a
+#: min-source parent array that is squared each sweep, so convergence
+#: needs O(log diameter) sweeps instead of O(diameter) ("Adaptive
+#: Work-Efficient Connected Components on the GPU", PAPERS.md).
+MODE_JUMP = "jump"
+#: jump acceleration always on, pull gates switched per sweep when the
+#: dirty-chunk density crosses ``pull_density`` — the default.
+MODE_AUTO = "auto"
+TRACE_MODES = (MODE_AUTO, MODE_PUSH, MODE_PULL, MODE_JUMP)
+#: dirty-chunk density (fraction of walk chunks dirty) above which AUTO
+#: turns the pull gates on for a sweep.  Below it the source frontier is
+#: sparse enough that dirty-chunk pruning already bounds the sweep, and
+#: the per-tile saturation pass would only add latency.
+DEFAULT_PULL_DENSITY = 0.25
+#: pointer doublings applied per sweep.  One doubling gives the classic
+#: 2^k reach-per-sweep schedule; two squares the relation twice per
+#: sweep (4^k), which at the 10M-actor benchmark geometry converges in
+#: ~4 sweeps instead of ~12 (tools/sweep_profile.py --simulate).
+JUMP_STEPS = 2
+#: per-sweep stat ring length for with_stats builds (sweeps beyond this
+#: fold into the last slot; fixpoints run ~4-12 sweeps)
+MAX_SWEEP_STATS = 32
+
+#: per-tile gate values consumed by dst_gate kernels
+GATE_PUSH = 0  # walk the dirty chunks inside the block's span (default)
+GATE_FULL = 1  # walk the FULL span (decremental repair re-derivation)
+GATE_SKIP = 2  # skip the block outright (saturated destination tile)
+
+
+def jump_parents(psrc, pdst, n: int) -> np.ndarray:
+    """Min-source jump-parent array: J[d] = the smallest source with a
+    live propagation pair into ``d``, sentinel ``n`` when none.
+
+    Minimum (not first/last) is the load-bearing choice: low slots are
+    the oldest, shallowest actors (roots intern first; preferential
+    attachment biases hub targets low), so the parent forest points
+    toward the seed-rich end of the graph — the min-label hooking of the
+    GPU connected-components literature.  Shaped (n + 1,) with J[n] = n
+    so pointer doubling can gather through the sentinel."""
+    j = np.full(n + 1, n, dtype=np.int32)
+    pdst = np.asarray(pdst, dtype=np.int64)
+    psrc = np.asarray(psrc, dtype=np.int64)
+    ok = (pdst < n) & (psrc < n)
+    np.minimum.at(j, pdst[ok], psrc[ok].astype(np.int32))
+    j[n] = n
+    return j
+
+
+def fold_jump_log(jump_parent, log, n: int, writes=None) -> None:
+    """Vectorized jump-parent maintenance for one pair-transition batch
+    ``[(insert?, src, dst, kind), ...]`` — the batched form of the
+    min-fold-on-insert / invalidate-on-remove rules (``jump_parents``),
+    shared by the single-device and mesh layout planes.
+
+    Order-insensitive and conservative: pointers built from any pair
+    removed in the batch are invalidated (even when an insert earlier
+    in the same batch created them), and inserts whose (src, dst) pair
+    is ALSO removed anywhere in the batch are not folded (their order
+    against the remove is lost once the batch is vectorized).  A
+    spurious invalidation or a skipped fold costs acceleration only;
+    a pointer surviving its pair's removal would let the jump sweep
+    cross a dead edge, which this can never produce.  Ids >= ``n``
+    (node spaces that grew past the layout) are ignored.
+
+    Mutates ``jump_parent`` in place; when ``writes`` is a dict the
+    changed entries are recorded there too (the device-mirror scatter
+    queue), O(changed) not O(batch)."""
+    if not log:
+        return
+    arr = np.asarray(log, dtype=np.int64).reshape(len(log), -1)
+    ins = arr[:, 0] != 0
+    src, dst = arr[:, 1], arr[:, 2]
+    ok = (src >= 0) & (src < n) & (dst >= 0) & (dst < n)
+    rs, rd = src[~ins & ok], dst[~ins & ok]
+    if rd.size:
+        hit = jump_parent[rd] == rs
+        hrd = rd[hit]
+        if hrd.size:
+            jump_parent[hrd] = n
+            if writes is not None:
+                for d in hrd.tolist():
+                    writes[d] = n
+    isrc, idst = src[ins & ok], dst[ins & ok]
+    if isrc.size and rd.size:
+        removed = set(zip(rs.tolist(), rd.tolist()))
+        keep = np.fromiter(
+            ((s, d) not in removed
+             for s, d in zip(isrc.tolist(), idst.tolist())),
+            bool, isrc.size,
+        )
+        isrc, idst = isrc[keep], idst[keep]
+    if isrc.size:
+        before = jump_parent[idst].copy()
+        np.minimum.at(
+            jump_parent, idst, isrc.astype(jump_parent.dtype)
+        )
+        if writes is not None:
+            after = jump_parent[idst]
+            changed = after < before
+            for d, v in zip(idst[changed].tolist(),
+                            after[changed].tolist()):
+                writes[d] = v
+
+
+def jump_parents_from_graph(
+    edge_src, edge_dst, edge_weight, supervisor, n: int
+) -> np.ndarray:
+    """jump_parents over a graph's live propagation pairs (edges with
+    positive weight + supervisor pointers)."""
+    live = edge_weight > 0
+    psrc = edge_src[live].astype(np.int64)
+    pdst = edge_dst[live].astype(np.int64)
+    sup_src = np.nonzero(supervisor >= 0)[0].astype(np.int64)
+    if sup_src.size:
+        psrc = np.concatenate([psrc, sup_src])
+        pdst = np.concatenate([pdst, supervisor[sup_src].astype(np.int64)])
+    return jump_parents(psrc, pdst, n)
+
+
+def bits_at(table, ids, n, jnp):
+    """Gather per-node bits from a packed word table for an int32 id
+    vector; ids >= n (the sentinel and any padding) read as 0."""
+    flat = table.reshape(-1)
+    word = jnp.minimum(ids >> 5, flat.shape[0] - 1)
+    return (((flat[word] >> (ids & 31)) & 1) > 0) & (ids < n)
+
+
+def jump_sweep(table, jump_j, trans_w, n, jnp, steps: int = JUMP_STEPS):
+    """One pointer-jump propagation step + ``steps`` pointer doublings.
+
+    Returns (hits, new_jump_j): ``hits`` is the (n,) bool plane of nodes
+    whose current jump parent is active in ``table`` (mark & ~halted —
+    the same source gate as edge propagation), and the parent array is
+    then advanced by squaring, extending each pointer through
+    ``trans_w``-transparent (in-use, non-halted) intermediates only.
+
+    Soundness: by construction J[v] always reaches v through a path of
+    live pairs whose intermediate nodes are all transparent, so
+    mark[J[v]] & ~halted[J[v]] implies the plain fixpoint would
+    eventually mark v — the jump only collapses the sweeps in between.
+    Parents never extend through an opaque node, and the host layer
+    invalidates J[d] whenever the pair it was built from is removed, so
+    a jump can never cross a deleted edge or a halted relay."""
+    hits = bits_at(table, jump_j[:n], n, jnp)
+    for _ in range(steps):
+        j2 = jump_j[jump_j]
+        can = bits_at(trans_w, jump_j, n, jnp) & (j2 < n)
+        jump_j = jnp.where(can, j2, jump_j)
+    return hits, jump_j
+
+
+def saturated_tiles(mark_w, iu_w, n_super, sup_words, jnp):
+    """Per-supertile saturation bits (int32, 1 = no unmarked in-use node
+    left): the destination-pull summary.  A saturated tile's blocks can
+    be skipped outright — every contribution they could make would land
+    on an already-marked or never-markable bit."""
+    un = (iu_w & ~mark_w).reshape(-1)[: n_super * sup_words]
+    return (
+        ~(un.reshape(n_super, sup_words).any(axis=1))
+    ).astype(jnp.int32)
+
+
+def hier_dirty_lists(table, table_prev, n_chunks, group_rows, n_super,
+                     sup_words, jnp):
+    """The hierarchical frontier: per-supertile summary bits above the
+    walk-chunk dirty lists.
+
+    Level 1 (coarse, destination space): one summary bit per supertile —
+    did any of its words change this sweep.  Feeds the pull gates (a
+    tile's saturation can only flip where its summary fired, so the
+    per-sweep saturation update is masked to the changed tiles and the
+    rest carry over) and the frontier-density stats.
+    Level 2 (fine, source space): the existing compacted dirty-chunk
+    prefix/list the kernels walk (``dirty_group_lists``) — the word
+    diff is shared between both levels (XLA CSEs the duplicate
+    comparison inside one trace).
+
+    Returns (d, l, changed, super_changed) with d/l/changed exactly as
+    ``dirty_group_lists`` produces them."""
+    d, l, changed = dirty_group_lists(table, table_prev, n_chunks,
+                                      group_rows, jnp)
+    flat = (table != table_prev).reshape(-1)[: n_super * sup_words]
+    super_changed = flat.reshape(n_super, sup_words).any(axis=1).astype(
+        jnp.int32
+    )
+    return d, l, changed, super_changed
 
 
 def _int8_mxu() -> bool:
@@ -706,13 +904,21 @@ def build_propagate(
     contribution is already in the mark vector.
 
     With ``dst_gate`` a fifth scalar-prefetch operand S (one int per
-    output tile, 0/1) forces blocks whose output tile is flagged to walk
+    output tile) selects the walk per block from the destination side:
+    ``GATE_FULL`` (1) forces blocks whose output tile is flagged to walk
     their FULL chunk span regardless of the dirty lists.  The decremental
     wake's repair pass needs this: after unmarking a suspect region, the
     region's supertiles must re-derive their contributions from ALL their
     in-edges — including sources whose table groups did not change —
     which the source-side dirty machinery cannot express
-    (ops/pallas_decremental.py).
+    (ops/pallas_decremental.py).  ``GATE_SKIP`` (2) skips the block
+    outright — the pull side of direction-optimizing propagation: a
+    saturated destination tile (no unmarked in-use node left) cannot
+    gain a bit from any contribution, so its blocks need not walk even a
+    dirty span.  ``GATE_PUSH`` (0) is the default dirty-chunk walk.
+    Skip wins over full: a tile both saturated and repair-gated has
+    nothing left to re-derive (contributions are not carried across
+    sweeps, only marks are).
     """
     import jax
     import jax.numpy as jnp
@@ -743,8 +949,13 @@ def build_propagate(
         j_lo = d_ref[c_lo]
         j_hi = d_ref[c_lo + span]
         if dst_gate:
-            gated = s_ref[meta1_ref[i] >> 1] > 0
-            n_iter = jnp.where(gated, span, j_hi - j_lo)
+            g = s_ref[meta1_ref[i] >> 1]
+            gated = g == GATE_FULL
+            n_iter = jnp.where(
+                g == GATE_SKIP,
+                0,
+                jnp.where(gated, span, j_hi - j_lo),
+            )
             l_cap = l_ref.shape[0] - 1
         else:
             gated = None
@@ -882,6 +1093,9 @@ def _build_trace_fn_multi(
     r_rows: int,
     s_rows: int,
     interpret: bool,
+    mode: str = MODE_PUSH,
+    pull_density: float = DEFAULT_PULL_DENSITY,
+    with_stats: bool = False,
 ):
     """Trace fn over one or more pair layouts sharing a node space.
 
@@ -900,11 +1114,23 @@ def _build_trace_fn_multi(
     combined *before* thresholding, so the result is identical to a
     single layout holding the union of the pairs.  This is what lets a
     churning graph keep a big, static "base" layout plus small delta
-    tiers (ops/pallas_incremental) instead of re-packing everything."""
+    tiers (ops/pallas_incremental) instead of re-packing everything.
+
+    ``mode`` selects the propagation strategy (module MODE_* docs); jump
+    and auto modes take a jump-parent operand right after flags/recv.
+    ``with_stats`` returns (marks, stats) where stats carries the sweep
+    count and per-sweep frontier decomposition (dirty chunks, changed
+    supertiles, tiles skipped, pull-gate decision) for the profiler."""
     import jax
     import jax.numpy as jnp
 
     F = trace_ops
+    require(
+        mode in TRACE_MODES, "config.trace_mode",
+        "bad trace mode", mode=mode, valid=TRACE_MODES,
+    )
+    use_jump = mode in (MODE_JUMP, MODE_AUTO)
+    use_pull = mode in (MODE_PULL, MODE_AUTO)
 
     geoms = {spec[-2:] for spec in specs if spec[0] != "xla"}
     assert len(geoms) == 1, "packed layouts must share (sub, group)"
@@ -912,15 +1138,22 @@ def _build_trace_fn_multi(
     group_rows = ROWS * group
 
     propagates = build_layout_propagates(
-        specs, n_super, r_rows, s_rows, interpret
+        specs, n_super, r_rows, s_rows, interpret, dst_gate=use_pull
     )
 
     n_words_pad = r_rows * LANE
     n_chunks = r_rows // group_rows  # dirty granularity = one walk group
     n_pad_nodes = n_super * s_rows * LANE  # contrib coverage, >= n
     t_rows = n_super * s_rows  # contrib rows (128 nodes each)
+    sup_words = s_rows * (LANE // WORD_BITS)  # words per supertile
+    # AUTO's per-sweep pull decision, in dirty-chunk counts
+    pull_cut = max(1, int(round(pull_density * n_chunks)))
 
-    def trace_fn(flags, recv_count, *layout_args):
+    def trace_fn(flags, recv_count, *rest):
+        if use_jump:
+            jump_j0, *layout_args = rest
+        else:
+            jump_j0, layout_args = None, rest
         in_use = (flags & F.FLAG_IN_USE) != 0
         halted = (flags & F.FLAG_HALTED) != 0
         seed = (
@@ -944,10 +1177,13 @@ def _build_trace_fn_multi(
             return unpack_table(words, n, jnp)
 
         def dirty_chunks(table, table_prev):
-            return dirty_group_lists(table, table_prev, n_chunks, group_rows, jnp)
+            return hier_dirty_lists(
+                table, table_prev, n_chunks, group_rows, n_super,
+                sup_words, jnp,
+            )
 
         def cond(carry):
-            return carry[-1]
+            return carry["changed"]
 
         sweep = build_sweep_contribs(specs, propagates, n, n_super, s_rows, jnp)
 
@@ -956,23 +1192,105 @@ def _build_trace_fn_multi(
         # bits stay 0 in both.
         iu_w = pack(in_use)
         nh_w = pack(~halted)
+        trans_w = iu_w & nh_w  # jump-transparent intermediates
+
+        # The level-1 summary is carried only when something consumes
+        # it: the pull gates (masked saturation update) or the stats.
+        track_super = use_pull or with_stats
 
         def body(carry):
-            mark_w, table, d, l, _ = carry
-            hits2d = sweep(table, d, l, layout_args)
+            mark_w, table = carry["mark"], carry["table"]
+            d, l = carry["d"], carry["l"]
+            n_dirty = d[n_chunks]
+            if use_pull:
+                # Destination-side pull gates: marks grow monotonically
+                # within one fixpoint so saturation only latches on,
+                # and a tile can only flip where the level-1 summary
+                # fired last sweep — the update is masked to those
+                # tiles, the rest carry over.
+                sat = jnp.where(
+                    carry["sup_ch"] > 0,
+                    saturated_tiles(mark_w, iu_w, n_super, sup_words,
+                                    jnp),
+                    carry["sat"],
+                )
+                if mode == MODE_AUTO:
+                    pull_on = n_dirty >= pull_cut
+                else:
+                    pull_on = jnp.array(True)
+                gate = jnp.where(pull_on, sat * GATE_SKIP,
+                                 jnp.zeros_like(sat))
+            else:
+                sat = None
+                pull_on = jnp.array(False)
+                gate = None
+            hits2d = sweep(table, d, l, layout_args, gate=gate)
             hit_w = pack2d(hits2d)
             new_mark_w = mark_w | (hit_w & iu_w)
+            if use_jump:
+                jh, jump_j = jump_sweep(
+                    table, carry["jump"], trans_w, n, jnp
+                )
+                new_mark_w = new_mark_w | (pack(jh) & iu_w)
             new_table = new_mark_w & nh_w
-            d2, l2, changed = dirty_chunks(new_table, table)
-            return new_mark_w, new_table, d2, l2, changed
+            d2, l2, changed, sup_ch2 = dirty_chunks(new_table, table)
+            out = dict(carry, mark=new_mark_w, table=new_table, d=d2,
+                       l=l2, changed=changed)
+            if track_super:
+                out["sup_ch"] = sup_ch2
+            if use_pull:
+                out["sat"] = sat
+            if use_jump:
+                out["jump"] = jump_j
+            if with_stats:
+                i = jnp.minimum(carry["sweep_i"], MAX_SWEEP_STATS - 1)
+                out["sweep_i"] = carry["sweep_i"] + 1
+                out["st_dirty"] = carry["st_dirty"].at[i].set(n_dirty)
+                out["st_super"] = carry["st_super"].at[i].set(
+                    carry["sup_ch"].sum()
+                )
+                if use_pull:
+                    out["st_skip"] = carry["st_skip"].at[i].set(
+                        jnp.where(pull_on, sat.sum(), 0)
+                    )
+                    out["st_pull"] = carry["st_pull"].at[i].set(
+                        pull_on.astype(jnp.int32)
+                    )
+            return out
 
         mark_w0 = pack(mark0)
         table0 = mark_w0 & nh_w
-        d0, l0, changed0 = dirty_chunks(table0, jnp.zeros_like(table0))
-        mark_w, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (mark_w0, table0, d0, l0, changed0)
+        d0, l0, changed0, sup_ch0 = dirty_chunks(
+            table0, jnp.zeros_like(table0)
         )
-        return unpack(mark_w)
+        carry0 = {"mark": mark_w0, "table": table0, "d": d0, "l": l0,
+                  "changed": changed0}
+        if track_super:
+            carry0["sup_ch"] = sup_ch0
+        if use_pull:
+            carry0["sat"] = saturated_tiles(
+                mark_w0, iu_w, n_super, sup_words, jnp
+            )
+        if use_jump:
+            carry0["jump"] = jump_j0.astype(jnp.int32)
+        if with_stats:
+            zero_stats = jnp.zeros((MAX_SWEEP_STATS,), jnp.int32)
+            carry0.update(
+                sweep_i=jnp.zeros((), jnp.int32), st_dirty=zero_stats,
+                st_super=zero_stats, st_skip=zero_stats,
+                st_pull=zero_stats,
+            )
+        out = jax.lax.while_loop(cond, body, carry0)
+        if not with_stats:
+            return unpack(out["mark"])
+        stats = {
+            "n_sweeps": out["sweep_i"],
+            "dirty_chunks": out["st_dirty"],
+            "changed_supers": out["st_super"],
+            "tiles_skipped": out["st_skip"],
+            "pull_on": out["st_pull"],
+        }
+        return unpack(out["mark"]), stats
 
     return jax.jit(trace_fn)
 
@@ -988,7 +1306,13 @@ def default_interpret() -> bool:
     return not is_tpu_platform(jax.devices()[0].platform)
 
 
-def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
+def get_trace_fn(
+    prep: Dict[str, np.ndarray],
+    interpret: bool | None = None,
+    mode: str = MODE_PUSH,
+    pull_density: float = DEFAULT_PULL_DENSITY,
+    with_stats: bool = False,
+):
     """Cached jitted trace fn for a prepared pair-array layout."""
     return get_trace_fn_multi(
         prep["n"],
@@ -997,6 +1321,9 @@ def get_trace_fn(prep: Dict[str, np.ndarray], interpret: bool | None = None):
         prep["r_rows"],
         prep["s_rows"],
         interpret,
+        mode=mode,
+        pull_density=pull_density,
+        with_stats=with_stats,
     )
 
 
@@ -1007,17 +1334,25 @@ def get_trace_fn_multi(
     r_rows: int,
     s_rows: int,
     interpret: bool | None = None,
+    mode: str = MODE_PUSH,
+    pull_density: float = DEFAULT_PULL_DENSITY,
+    with_stats: bool = False,
 ):
     """Cached jitted trace fn over one or more pair layouts (operand
     arrays per layout in ``device_args`` order, appended after
-    flags/recv)."""
+    flags/recv — and, for jump/auto modes, after the jump-parent
+    operand)."""
     if interpret is None:
         interpret = default_interpret()
-    key = (n, tuple(specs), n_super, r_rows, s_rows, interpret, _int8_mxu())
+    key = (
+        n, tuple(specs), n_super, r_rows, s_rows, interpret, _int8_mxu(),
+        mode, pull_density, with_stats,
+    )
     fn = _fn_cache.get(key)
     if fn is None:
         fn = _build_trace_fn_multi(
-            n, tuple(specs), n_super, r_rows, s_rows, interpret
+            n, tuple(specs), n_super, r_rows, s_rows, interpret,
+            mode=mode, pull_density=pull_density, with_stats=with_stats,
         )
         _fn_cache[key] = fn
     return fn
@@ -1029,12 +1364,24 @@ def trace_marks_prepared(flags, recv_count, prep: Dict[str, np.ndarray]) -> np.n
 
 
 def trace_marks_layouts(
-    flags, recv_count, preps, interpret: bool | None = None
-) -> np.ndarray:
+    flags,
+    recv_count,
+    preps,
+    interpret: bool | None = None,
+    mode: str = MODE_PUSH,
+    pull_density: float = DEFAULT_PULL_DENSITY,
+    jump_parent: np.ndarray | None = None,
+    with_stats: bool = False,
+):
     """Run the Pallas-backed trace against one or more pair layouts that
     share a node space (their per-node contributions are combined before
     thresholding, so the union of the layouts' pairs propagates).  The
-    first layout must be a packed (non-xla) one; it pins the geometry."""
+    first layout must be a packed (non-xla) one; it pins the geometry.
+
+    ``mode`` jump/auto requires ``jump_parent`` — the (n + 1,) min-source
+    parent array over the SAME live pair set the layouts hold
+    (jump_parents / IncrementalPallasLayout.jump_parent); a stale parent
+    crossing a deleted pair would propagate marks along a dead edge."""
     first = preps[0]
     n = first["n"]
     assert "xla_src" not in first, "first layout pins the packed geometry"
@@ -1055,16 +1402,31 @@ def trace_marks_layouts(
         first["r_rows"],
         first["s_rows"],
         interpret,
+        mode=mode,
+        pull_density=pull_density,
+        with_stats=with_stats,
     )
     args = []
+    if mode in (MODE_JUMP, MODE_AUTO):
+        require(
+            jump_parent is not None, "trace.jump_parent",
+            "jump modes need the parent array", mode=mode,
+        )
+        args.append(jump_parent)
     for p in preps:
         args.extend(device_args(p))
     out = fn(flags[:n], recv_count[:n], *args)
+    if with_stats:
+        marks, stats = out
+        return np.asarray(marks), {
+            k: np.asarray(v) for k, v in stats.items()
+        }
     return np.asarray(out)
 
 
 def trace_marks_pallas(
-    flags, recv_count, supervisor, edge_src, edge_dst, edge_weight
+    flags, recv_count, supervisor, edge_src, edge_dst, edge_weight,
+    mode: str = MODE_PUSH,
 ) -> np.ndarray:
     """Same contract as trace_marks_np/_jax, Pallas propagation inside."""
     n = flags.shape[0]
@@ -1075,4 +1437,16 @@ def trace_marks_pallas(
         np.asarray(supervisor),
         n,
     )
-    return trace_marks_prepared(np.asarray(flags), np.asarray(recv_count), prep)
+    jp = None
+    if mode in (MODE_JUMP, MODE_AUTO):
+        jp = jump_parents_from_graph(
+            np.asarray(edge_src),
+            np.asarray(edge_dst),
+            np.asarray(edge_weight),
+            np.asarray(supervisor),
+            n,
+        )
+    return trace_marks_layouts(
+        np.asarray(flags), np.asarray(recv_count), [prep], mode=mode,
+        jump_parent=jp,
+    )

@@ -26,6 +26,8 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from ..utils.validation import require
+
 
 def _jax():
     import jax
@@ -196,7 +198,26 @@ def make_local_shard_ops(axis, words_pad, r_rows, n_pad, shard_size, jnp):
 
         return sweep_hits
 
-    return pack_words, gather_table, make_sweep
+    def jump_local(table, trans_table, jump_j):
+        """One pointer-jump propagation for this shard's nodes + one
+        round of pointer doubling.  ``jump_j`` is the REPLICATED global
+        min-source parent array (n_pad + 1,): the doubling runs
+        identically on every shard (gathers through the replicated
+        all-gathered tables), so no collective is needed to keep the
+        parents coherent — the shard only slices its own destinations
+        for the propagation gather."""
+        idx = jax.lax.axis_index(axis)
+        j_loc = jax.lax.dynamic_slice(
+            jump_j, (idx * shard_size,), (shard_size,)
+        )
+        hits = pt.bits_at(table, j_loc, n_pad, jnp)
+        for _ in range(pt.JUMP_STEPS):
+            j2 = jump_j[jump_j]
+            can = pt.bits_at(trans_table, jump_j, n_pad, jnp) & (j2 < n_pad)
+            jump_j = jnp.where(can, j2, jump_j)
+        return hits, jump_j
+
+    return pack_words, gather_table, make_sweep, jump_local
 
 
 def make_sharded_trace(mesh, axis: str = "gc"):
@@ -207,10 +228,6 @@ def make_sharded_trace(mesh, axis: str = "gc"):
     leading device axis.
     """
     jax, jnp = _jax()
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_devices = mesh.devices.size
@@ -267,11 +284,14 @@ def make_sharded_trace(mesh, axis: str = "gc"):
     spec_nodes = P(axis)
     spec_pairs = P(axis, None)
 
-    fn = shard_map(
+    # check_vma/check_rep must be off: jax has no replication rule for
+    # the while fixpoint under shard_map (the compat shim handles both
+    # keyword spellings across jax versions).
+    fn = _shard_map_compat(
         local_trace,
-        mesh=mesh,
-        in_specs=(spec_nodes, spec_nodes, spec_pairs, spec_pairs),
-        out_specs=spec_pairs,
+        mesh,
+        (spec_nodes, spec_nodes, spec_pairs, spec_pairs),
+        spec_pairs,
     )
 
     @jax.jit
@@ -370,6 +390,8 @@ def make_sharded_pallas_trace(
     axis: str = "gc",
     sub: int = None,
     group: int = None,
+    mode: str = None,
+    pull_density: float = None,
 ):
     """The mesh trace with the Pallas propagation kernel per shard.
 
@@ -381,9 +403,16 @@ def make_sharded_pallas_trace(
     dst).  The dirty-chunk diff is computed on the *global* table, so the
     convergence decision is replicated — no psum needed.
 
-    fn(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst) -> mark
-    with flags/recv sharded by node range, the rest sharded on their
-    leading device axis.
+    ``mode`` (pallas_trace MODE_*, default push) adds the sharded forms
+    of the direction-optimizing machinery: jump/auto take one extra
+    trailing operand — the replicated (n_pad + 1,) jump-parent array —
+    and pull/auto skip blocks whose local destination supertile is
+    saturated (the pull decision and the dirty density are both derived
+    from replicated tables, so every shard agrees on the sweep plan).
+
+    fn(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst[, jump_j])
+    -> mark with flags/recv sharded by node range, layout operands
+    sharded on their leading device axis, jump_j replicated.
     """
     jax, jnp = _jax()
     from jax.sharding import PartitionSpec as P
@@ -396,8 +425,19 @@ def make_sharded_pallas_trace(
         d_sub, d_group = pt.default_geometry(interpret)
         sub = d_sub if sub is None else sub
         group = d_group if group is None else group
+    if mode is None:
+        mode = pt.MODE_PUSH
+    if pull_density is None:
+        pull_density = pt.DEFAULT_PULL_DENSITY
+    require(
+        mode in pt.TRACE_MODES, "config.trace_mode",
+        "bad trace mode", mode=mode, valid=pt.TRACE_MODES,
+    )
+    use_jump = mode in (pt.MODE_JUMP, pt.MODE_AUTO)
+    use_pull = mode in (pt.MODE_PULL, pt.MODE_AUTO)
     super_sz = s_rows * pt.LANE
     n_super_shard = shard_size // super_sz
+    sup_words = s_rows * (pt.LANE // pt.WORD_BITS)
     # dst-gated kernel with a constant zero gate == the plain kernel;
     # using it here keeps ONE kernel build shared with the decremental
     # wake (which passes a real gate on its repair sweep).
@@ -408,8 +448,10 @@ def make_sharded_pallas_trace(
     group_rows = pt.ROWS * group
     n_chunks = r_rows // group_rows
     words_pad = r_rows * pt.LANE
+    pull_cut = max(1, int(round(pull_density * n_chunks)))
 
-    def local_trace(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
+    def local_trace(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc,
+                    bdst, *rest):
         flags = flags.reshape(-1)
         recv = recv.reshape(-1)
         bmeta1 = bmeta1.reshape(-1)
@@ -418,12 +460,15 @@ def make_sharded_pallas_trace(
         emeta = emeta.reshape(-1, pt.LANE)
         bsrc = bsrc.reshape(-1)
         bdst = bdst.reshape(-1)
+        jump_j0 = rest[0] if use_jump else None
 
         in_use, halted, seed = _seed_masks(flags, recv)
         mark0 = in_use & (~halted) & seed
 
-        pack_words, gather_table, make_sweep = make_local_shard_ops(
-            axis, words_pad, r_rows, n_pad, shard_size, jnp
+        pack_words, gather_table, make_sweep, jump_local = (
+            make_local_shard_ops(
+                axis, words_pad, r_rows, n_pad, shard_size, jnp
+            )
         )
         sweep_hits = make_sweep(
             propagate, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst
@@ -440,20 +485,43 @@ def make_sharded_pallas_trace(
 
         iu_w = pack_words(in_use)
         nh_w = pack_words(~halted)
+        # replicated transparency table for the pointer doubling
+        trans_table = (
+            gather_table(iu_w & nh_w) if use_jump else None
+        )
 
         def body(carry):
-            mark_w, table, d, l, _ = carry
-            hits2d = sweep_hits(table, d, l, zero_gate)
+            mark_w, table, d, l, jump_j, _ = carry
+            if use_pull:
+                sat = pt.saturated_tiles(
+                    mark_w, iu_w, n_super_shard, sup_words, jnp
+                )
+                if mode == pt.MODE_AUTO:
+                    pull_on = d[n_chunks] >= pull_cut
+                else:
+                    pull_on = jnp.array(True)
+                gate = jnp.where(pull_on, sat * pt.GATE_SKIP, zero_gate)
+            else:
+                gate = zero_gate
+            hits2d = sweep_hits(table, d, l, gate)
             new_mark_w = mark_w | (pt.pack_hits_words(hits2d, jnp) & iu_w)
+            if use_jump:
+                jh, jump_j = jump_local(table, trans_table, jump_j)
+                new_mark_w = new_mark_w | (pack_words(jh) & iu_w)
             new_table = gather_table(new_mark_w & nh_w)
             d2, l2, changed = dirty_chunks(new_table, table)
-            return new_mark_w, new_table, d2, l2, changed
+            return new_mark_w, new_table, d2, l2, jump_j, changed
 
         mark_w0 = pack_words(mark0)
         table0 = gather_table(mark_w0 & nh_w)
         d0, l0, changed0 = dirty_chunks(table0, jnp.zeros_like(table0))
-        mark_w, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (mark_w0, table0, d0, l0, changed0)
+        jj0 = (
+            jump_j0.reshape(-1).astype(jnp.int32)
+            if use_jump
+            else jnp.zeros((1,), jnp.int32)
+        )
+        mark_w, _, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (mark_w0, table0, d0, l0, jj0, changed0)
         )
         shifts = jnp.arange(pt.WORD_BITS, dtype=jnp.int32)
         bits = (mark_w[:, None] >> shifts[None, :]) & 1
@@ -473,13 +541,13 @@ def make_sharded_pallas_trace(
         spec_dev,
         spec_dev,
     )
+    if use_jump:
+        in_specs = in_specs + (P(),)  # replicated jump parents
     fn = _shard_map_compat(local_trace, mesh, in_specs, spec_dev)
 
     @jax.jit
-    def traced(flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst):
-        return fn(
-            flags, recv, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst
-        ).reshape(-1)
+    def traced(*args):
+        return fn(*args).reshape(-1)
 
     return traced
 
@@ -589,6 +657,8 @@ def make_sharded_decremental_wake(
     axis: str = "gc",
     sub: int = None,
     group: int = None,
+    mode: str = None,
+    pull_density: float = None,
 ):
     """The decremental wake (suspect closure + destination-gated repair,
     ops/pallas_decremental.py) on the sharded data plane: per-wake cost
@@ -597,14 +667,17 @@ def make_sharded_decremental_wake(
 
     fn(flags, recv, del_w, fresh_w, prev_mark_w, prev_seed_w,
        prev_halted_w, prev_iu_w, prev_active_w,
-       bmeta1, bmeta2, row_pos, emeta, bsrc, bdst)
+       bmeta1, bmeta2, row_pos, emeta, bsrc, bdst[, jump_j])
       -> (mark (bool[n_pad]), mark_w, seed_w, halted_w, iu_w, active_w)
 
     flags/recv sharded by node range; every *_w operand is the flat word
     array (n_pad/32 ints) sharded by word range (same node partition);
     layout operands as in make_sharded_pallas_trace.  A zeroed previous
     state degenerates to the full derivation from seeds, so cold start
-    and post-rebuild wakes need no separate path.
+    and post-rebuild wakes need no separate path.  ``mode`` applies to
+    the repair fixpoint exactly as in the single-device wake
+    (ops/pallas_decremental.py): jump/auto take the replicated
+    jump-parent operand, pull/auto skip saturated local supertiles.
     """
     jax, jnp = _jax()
     from jax.sharding import PartitionSpec as P
@@ -617,6 +690,16 @@ def make_sharded_decremental_wake(
         d_sub, d_group = pt.default_geometry(interpret)
         sub = d_sub if sub is None else sub
         group = d_group if group is None else group
+    if mode is None:
+        mode = pt.MODE_PUSH
+    if pull_density is None:
+        pull_density = pt.DEFAULT_PULL_DENSITY
+    require(
+        mode in pt.TRACE_MODES, "config.trace_mode",
+        "bad trace mode", mode=mode, valid=pt.TRACE_MODES,
+    )
+    use_jump = mode in (pt.MODE_JUMP, pt.MODE_AUTO)
+    use_pull = mode in (pt.MODE_PULL, pt.MODE_AUTO)
     super_sz = s_rows * pt.LANE
     n_super_shard = shard_size // super_sz
     propagate = pt.build_propagate(
@@ -627,10 +710,12 @@ def make_sharded_decremental_wake(
     n_chunks = r_rows // group_rows
     words_pad = r_rows * pt.LANE
     sup_words = s_rows * (pt.LANE // pt.WORD_BITS)
+    pull_cut = max(1, int(round(pull_density * n_chunks)))
 
     def local_wake(flags, recv, del_w, fresh_w, p_mark, p_seed, p_halt,
                    p_iu, p_active, bmeta1, bmeta2, row_pos, emeta,
-                   bsrc, bdst):
+                   bsrc, bdst, *rest):
+        jump_j0 = rest[0] if use_jump else None
         flags = flags.reshape(-1)
         recv = recv.reshape(-1)
         del_w = del_w.reshape(-1)
@@ -648,8 +733,10 @@ def make_sharded_decremental_wake(
         bdst = bdst.reshape(-1)
 
         in_use, halted, seed = _seed_masks(flags, recv)
-        pack_words, gather_table, make_sweep = make_local_shard_ops(
-            axis, words_pad, r_rows, n_pad, shard_size, jnp
+        pack_words, gather_table, make_sweep, jump_local = (
+            make_local_shard_ops(
+                axis, words_pad, r_rows, n_pad, shard_size, jnp
+            )
         )
         sweep_hits = make_sweep(
             propagate, bmeta1, bmeta2, row_pos, emeta, bsrc, bdst
@@ -718,23 +805,53 @@ def make_sharded_decremental_wake(
         # first (gated) sweep or the collectives deadlock.
         any_gate = jax.lax.psum(suspect_g.sum(), axis) > 0
         run0 = rch0 | any_gate
+        # replicated transparency table for the pointer doubling
+        trans_table = gather_table(iu_w & nh_w) if use_jump else None
 
         def r_cond(carry):
             return carry[-1]
 
         def r_body(carry):
-            mark_w, table, d, l, use_gate, _ = carry
-            gate = jnp.where(use_gate, suspect_g, zero_gate)
+            mark_w, table, d, l, use_gate, jump_j, _ = carry
+            # Gate composition as in the single-device wake: the repair
+            # forcing (GATE_FULL on suspect tiles, first sweep only)
+            # under the pull skip (GATE_SKIP on saturated tiles).  Both
+            # inputs to the pull decision — the dirty density (global
+            # table diff) and the per-shard saturation of LOCAL tiles —
+            # are derived from replicated or own-shard state, so every
+            # shard agrees on the sweep plan without a collective.
+            base_gate = jnp.where(use_gate, suspect_g, zero_gate)
+            if use_pull:
+                sat = pt.saturated_tiles(
+                    mark_w, iu_w, n_super_shard, sup_words, jnp
+                )
+                if mode == pt.MODE_AUTO:
+                    pull_on = d[n_chunks] >= pull_cut
+                else:
+                    pull_on = jnp.array(True)
+                gate = jnp.where(pull_on & (sat > 0), pt.GATE_SKIP,
+                                 base_gate)
+            else:
+                gate = base_gate
             hits2d = sweep_hits(table, d, l, gate)
             new_mark = mark_w | (pack2d(hits2d) & iu_w)
+            if use_jump:
+                jh, jump_j = jump_local(table, trans_table, jump_j)
+                new_mark = new_mark | (pack_words(jh) & iu_w)
             new_table = gather_table(new_mark & nh_w)
             d2, l2, changed = dirty_chunks(new_table, table)
-            return new_mark, new_table, d2, l2, jnp.array(False), changed
+            return (new_mark, new_table, d2, l2, jnp.array(False),
+                    jump_j, changed)
 
-        mark_w, _, _, _, _, _ = jax.lax.while_loop(
+        jj0 = (
+            jump_j0.reshape(-1).astype(jnp.int32)
+            if use_jump
+            else jnp.zeros((1,), jnp.int32)
+        )
+        mark_w, _, _, _, _, _, _ = jax.lax.while_loop(
             r_cond,
             r_body,
-            (mark_w0, table0, rd0, rl0, jnp.array(True), run0),
+            (mark_w0, table0, rd0, rl0, jnp.array(True), jj0, run0),
         )
         active_w = mark_w & nh_w
 
@@ -758,6 +875,8 @@ def make_sharded_decremental_wake(
         spec_dev, spec_dev, spec_dev3, spec_dev3,  # layout
         spec_dev, spec_dev,  # buckets
     )
+    if use_jump:
+        in_specs = in_specs + (P(),)  # replicated jump parents
     out_specs = (spec_dev,) * 6
     fn = _shard_map_compat(local_wake, mesh, in_specs, out_specs)
 

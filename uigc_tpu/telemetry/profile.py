@@ -35,6 +35,14 @@ from ..utils import events
 
 PHASES = ("ingest", "fold", "trace", "sweep", "broadcast")
 
+#: DEVICE_TRACE event fields copied into the per-wake record (the
+#: fixpoint's per-sweep frontier decomposition; engines/crgc/arrays.py
+#: _stamp_sweep_stats stamps them, tools/sweep_profile.py reads them)
+_SWEEP_FIELDS = (
+    "trace_mode", "n_sweeps", "sweep_dirty_chunks",
+    "sweep_changed_supers", "sweep_tiles_skipped", "sweep_pull_on",
+)
+
 
 class _PhaseFrame:
     __slots__ = ("name", "acc", "last_start")
@@ -81,7 +89,7 @@ class _Wake:
     """Accounting for one in-flight collector wake."""
 
     __slots__ = ("profiler", "thread", "t0", "start", "phases", "stack",
-                 "device_s", "sweep_s")
+                 "device_s", "sweep_s", "trace_fields")
 
     def __init__(self, profiler: "WakeProfiler"):
         self.profiler = profiler
@@ -92,6 +100,7 @@ class _Wake:
         self.stack: List[_PhaseFrame] = []
         self.device_s = 0.0
         self.sweep_s = 0.0
+        self.trace_fields: Dict[str, Any] = {}
 
     def phase(self, name: str) -> _Phase:
         return _Phase(self, name)
@@ -140,6 +149,7 @@ class WakeProfiler:
             "wall_s": wall_s,
             "device_s": wake.device_s,
             "phases": phases,
+            **wake.trace_fields,
             **fields,
         }
         with self._lock:
@@ -168,6 +178,14 @@ class WakeProfiler:
         duration = fields.get("duration_s") or 0.0
         if name == events.DEVICE_TRACE:
             wake.device_s += duration
+            # Per-sweep frontier decomposition stamped by the device
+            # backends (arrays._stamp_sweep_stats / sweep_profile):
+            # carried into the per-wake record — the data the
+            # pull-density threshold is tuned from (PROFILING.md
+            # "Reading sweep_profile").
+            for key in _SWEEP_FIELDS:
+                if key in fields:
+                    wake.trace_fields[key] = fields[key]
         else:
             wake.sweep_s += duration
 
