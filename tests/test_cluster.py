@@ -286,13 +286,19 @@ def test_unknown_frame_kind_neither_crashes_nor_desyncs(event_log):
         connect_mesh(nodes)
         # A speaks a frame kind from the future, mid-stream.
         assert a.fabric.send_frame(b.address, ("frame-from-the-future", 1, 2, 3))
-        # Then normal entity traffic keyed to land on B.
-        b_keys = [
-            f"k{i}"
-            for i in range(200)
-            if a.cluster.home_of(f"k{i}") == b.address
-        ][:10]
-        assert b_keys, "no key homed on B?"
+
+        # Then normal entity traffic keyed to land on B.  B only homes
+        # keys once A's shard table has adopted it as a member, so wait
+        # for the membership gossip rather than racing it.
+        def keys_on_b():
+            return [
+                f"k{i}"
+                for i in range(200)
+                if a.cluster.home_of(f"k{i}") == b.address
+            ][:10]
+
+        assert settle(lambda: bool(keys_on_b())), "no key homed on B?"
+        b_keys = keys_on_b()
         for k in b_keys:
             a.cluster.entity_ref("counter", k).tell(("incr",))
         assert settle(lambda: b.region.active_count() >= len(b_keys))

@@ -1,0 +1,805 @@
+"""Liveness inspector suite (uigc_tpu/telemetry/inspect.py).
+
+Layers, bottom up:
+
+- kernel parity: the parents-capturing mark fixpoints (numpy + XLA)
+  agree with the plain trace bit-for-bit and produce a valid marking
+  forest (every non-seed marked node has a marked parent reachable over
+  a real positive edge / supervisor pointer);
+- gating: plain wakes never run the capture kernels
+  (stats-variant discipline); capture-enabled systems store a
+  verdict-exact parent array that the inspector resolves;
+- why-live path parity against the uigcsan pointer oracle under random
+  churn — every hop of every live actor's retaining path must exist in
+  the sanitizer's independent oracle;
+- snapshot-under-concurrent-fold safety, flight-recorder diffing, leak
+  watchdog true/false-positive behavior;
+- exporters: JSONL rotation with ordered replay, /healthz and
+  wake-phase histograms, /snapshot + /inspect HTTP endpoints;
+- cross-node: "snap" codec round-trips, 2-node merged snapshot, and a
+  seeded dropped "snap" frame degrading to a partial merge;
+- UL008: the read-only lint contract holds for the real inspect.py and
+  catches a mutating one.
+"""
+
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from uigc_tpu import (
+    AbstractBehavior,
+    ActorTestKit,
+    Behaviors,
+    Message,
+    NoRefs,
+)
+from uigc_tpu.ops import trace as trace_ops
+from uigc_tpu.runtime.faults import FaultPlan
+from uigc_tpu.runtime.node import NodeFabric
+from uigc_tpu.runtime.system import ActorSystem
+from uigc_tpu.runtime import wire
+from uigc_tpu.telemetry.exporter import JsonlEventSink, replay_jsonl
+from uigc_tpu.telemetry.inspect import (
+    FlightRecorder,
+    LeakWatchdog,
+    diff_snapshots,
+    merge_snapshots,
+    snapshot_graph,
+    validate_why_live,
+    why_live,
+)
+from uigc_tpu.utils import events
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    yield
+    events.recorder.disable()
+    events.recorder.reset()
+    with events.recorder._lock:
+        events.recorder._listeners.clear()
+
+
+# ------------------------------------------------------------------- #
+# Workload pieces
+# ------------------------------------------------------------------- #
+
+
+class _Ping(NoRefs):
+    pass
+
+
+class _Give(Message):
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,) if self.ref is not None else ()
+
+
+class _Worker(AbstractBehavior):
+    def on_message(self, msg):
+        return self
+
+
+class _Keeper(AbstractBehavior):
+    def __init__(self, context):
+        super().__init__(context)
+        self.held = []
+
+    def on_message(self, msg):
+        if isinstance(msg, _Give) and msg.ref is not None:
+            self.held.append(msg.ref)
+        return self
+
+
+class _ChainRoot(AbstractBehavior):
+    """root -> keeper -> kept: after the hand-off the kept actor is
+    retained only through the keeper (a 2-hop why-live chain), plus a
+    leaked worker pinned by the root with zero traffic."""
+
+    def __init__(self, context):
+        super().__init__(context)
+        self.keeper = context.spawn(Behaviors.setup(_Keeper), "keeper")
+        self.kept = context.spawn(Behaviors.setup(_Worker), "kept")
+        self.leaked = context.spawn(Behaviors.setup(_Worker), "leaked")
+        self.workers = [
+            context.spawn(Behaviors.setup(_Worker), f"w{i}") for i in range(3)
+        ]
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, _Give):
+            self.keeper.tell(
+                _Give(ctx.create_ref(self.kept, self.keeper)), ctx
+            )
+            ctx.release(self.kept)
+            self.kept = None
+        elif isinstance(msg, _Ping):
+            for worker in self.workers:
+                worker.tell(_Ping(), ctx)
+        return self
+
+
+def _chain_kit(extra=None, name="inspectkit"):
+    config = {
+        "uigc.crgc.wakeup-interval": 10,
+        "uigc.telemetry.inspect": True,
+        "uigc.telemetry.snapshot-every": 1,
+    }
+    if extra:
+        config.update(extra)
+    kit = ActorTestKit(config=config, name=name)
+    root = kit.spawn(Behaviors.setup_root(_ChainRoot), "root")
+    root.tell(_Give(None))  # trigger the kept hand-off
+    time.sleep(0.15)
+    return kit, root
+
+
+def _key_of(snapshot, name_suffix):
+    for key, rec in snapshot["actors"].items():
+        if rec.get("name", "").endswith(name_suffix):
+            return key
+    return None
+
+
+# ------------------------------------------------------------------- #
+# Kernel parity
+# ------------------------------------------------------------------- #
+
+
+def _random_graph(rng, n):
+    flags = np.where(
+        rng.random(n) < 0.85,
+        rng.integers(0, 64, n) | trace_ops.FLAG_IN_USE,
+        0,
+    ).astype(np.uint8)
+    recv = rng.integers(-2, 3, n).astype(np.int64)
+    sup = np.where(
+        rng.random(n) < 0.4, rng.integers(0, n, n), -1
+    ).astype(np.int32)
+    m = int(rng.integers(1, 4 * n))
+    esrc = rng.integers(0, n, m).astype(np.int32)
+    edst = rng.integers(0, n, m).astype(np.int32)
+    ew = rng.integers(-1, 3, m).astype(np.int64)
+    return flags, recv, sup, esrc, edst, ew
+
+
+def _assert_valid_parents(flags, recv, sup, esrc, edst, ew, mark, parent):
+    seeds = trace_ops.pseudoroots_np(flags, recv)
+    for i in range(len(flags)):
+        p = int(parent[i])
+        if mark[i] and not seeds[i]:
+            assert p >= 0 and mark[p]
+        if p >= 0:
+            has_edge = bool(np.any((esrc == p) & (edst == i) & (ew > 0)))
+            assert has_edge or sup[p] == i
+            # the marker must propagate: in-use, not halted
+            assert flags[p] & trace_ops.FLAG_IN_USE
+            assert not (flags[p] & trace_ops.FLAG_HALTED)
+
+
+def test_parents_kernels_match_plain_trace_and_each_other():
+    rng = np.random.default_rng(7)
+    from uigc_tpu.ops import pallas_trace as pt
+
+    for trial in range(25):
+        n = int(rng.integers(4, 100))
+        flags, recv, sup, esrc, edst, ew = _random_graph(rng, n)
+        base = trace_ops.trace_marks_np(flags, recv, sup, esrc, edst, ew)
+        mark, parent = trace_ops.trace_marks_np_parents(
+            flags, recv, sup, esrc, edst, ew
+        )
+        assert np.array_equal(base, mark)
+        _assert_valid_parents(flags, recv, sup, esrc, edst, ew, mark, parent)
+        if trial < 6:  # device variant: fewer trials, compile cost
+            dmark, dparent = pt.marking_parents_jax(
+                flags, recv, sup, esrc, edst, ew
+            )
+            assert np.array_equal(mark, dmark)
+            assert np.array_equal(parent, dparent.astype(np.int64))
+
+
+# ------------------------------------------------------------------- #
+# Live-system why-live + gating
+# ------------------------------------------------------------------- #
+
+
+def test_why_live_chain_and_capture_gating():
+    kit, root = _chain_kit(
+        extra={"uigc.telemetry.why-live-capture": True}
+    )
+    try:
+        graph = kit.system.engine.bookkeeper.shadow_graph
+        insp = kit.system.telemetry.inspector
+        assert insp is not None and insp.parent_capture
+        deadline = time.monotonic() + 10.0
+        result = {}
+        while time.monotonic() < deadline:
+            result = insp.why_live("kept")
+            if result.get("verdict") == "live" and len(result["path"]) >= 2:
+                break
+            time.sleep(0.05)
+        assert result.get("verdict") == "live", result
+        # verdict-exact capture was used, not an on-demand derivation
+        assert result.get("parents") == "captured"
+        names = [hop["from_name"] for hop in result["path"]]
+        assert any("keeper" in (n or "") for n in names), result
+        assert result["root_reasons"], result
+        snap = insp.snapshot()
+        assert validate_why_live(snap, result) == []
+        assert graph.last_parents is not None
+    finally:
+        kit.shutdown()
+
+
+def test_parent_capture_gated_off_by_default(monkeypatch):
+    """Plain wakes must never touch the parents kernels — the
+    stats-variant gating parity bar (off-path overhead is zero)."""
+    called = []
+    real = trace_ops.trace_marks_np_parents
+    monkeypatch.setattr(
+        trace_ops,
+        "trace_marks_np_parents",
+        lambda *a, **k: called.append(1) or real(*a, **k),
+    )
+    kit, root = _chain_kit(name="gatingkit")
+    try:
+        graph = kit.system.engine.bookkeeper.shadow_graph
+        for _ in range(5):
+            root.tell(_Ping())
+            time.sleep(0.03)
+        assert graph.capture_parents is False
+        assert graph.last_parents is None
+        assert called == []
+        # on-demand why-live derives parents without flipping the gate
+        result = kit.system.telemetry.inspector.why_live("kept")
+        assert result["verdict"] in ("live", "collectable")
+        assert graph.capture_parents is False
+    finally:
+        kit.shutdown()
+
+
+class _ChurnRoot(AbstractBehavior):
+    def __init__(self, context, rng, population):
+        super().__init__(context)
+        self.rng = rng
+        self.acq = []
+        self.population = population
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, _Ping):
+            for _ in range(4):
+                p = self.rng.random()
+                if p < 0.45 or not self.acq:
+                    if len(self.acq) < self.population:
+                        self.acq.append(
+                            ctx.spawn_anonymous(Behaviors.setup(_Keeper))
+                        )
+                elif p < 0.7 and len(self.acq) >= 2:
+                    a, b = self.rng.sample(self.acq, 2)
+                    a.tell(_Give(ctx.create_ref(b, a)), ctx)
+                elif p < 0.85:
+                    victim = self.acq.pop(self.rng.randrange(len(self.acq)))
+                    ctx.release(victim)
+                else:
+                    self.rng.choice(self.acq).tell(_Ping(), ctx)
+        return self
+
+
+def test_why_live_parity_with_sanitizer_oracle_under_churn():
+    """Acceptance: every live actor's retaining path validates against
+    the uigcsan pointer oracle — each created hop is a positive-count
+    edge in the oracle, each supervisor hop matches, and the chain head
+    is an oracle pseudoroot."""
+    kit = ActorTestKit(
+        config={
+            "uigc.crgc.wakeup-interval": 10,
+            "uigc.telemetry.inspect": True,
+            "uigc.analysis.sanitizer": True,
+        },
+        name="paritykit",
+    )
+    rng = random.Random(20260803)
+    try:
+        root = kit.spawn(
+            Behaviors.setup_root(lambda ctx: _ChurnRoot(ctx, rng, 40)),
+            "root",
+        )
+        for _ in range(12):
+            root.tell(_Ping())
+            time.sleep(0.03)
+        time.sleep(0.3)  # settle: no in-flight churn during the check
+        san = kit.system.sanitizer
+        insp = kit.system.telemetry.inspector
+        snap = insp.snapshot()
+        checked = 0
+        with san._lock:
+            oracle = san.oracle
+            by_key = {
+                f"{cell.system.address}#{cell.uid}": shadow
+                for cell, shadow in oracle.shadow_map.items()
+            }
+            for key, rec in snap["actors"].items():
+                result = why_live(snap, key)
+                assert validate_why_live(snap, result) == [], (key, result)
+                if result["verdict"] != "live":
+                    continue
+                checked += 1
+                head = by_key.get(result["chain"][0])
+                assert head is not None, result
+                assert oracle.is_pseudo_root(head), result
+                for hop in result["path"]:
+                    src = by_key.get(hop["from"])
+                    dst = by_key.get(hop["to"])
+                    assert src is not None and dst is not None, hop
+                    if hop["kind"] == "created":
+                        assert src.outgoing.get(dst, 0) > 0, hop
+                    else:
+                        assert src.supervisor is dst, hop
+        assert checked >= 5, f"churn left too few live actors ({checked})"
+        assert kit.system.sanitizer.violations == []
+    finally:
+        kit.shutdown()
+
+
+class _FakeSystem:
+    def __init__(self, address):
+        self.address = address
+
+
+class _FakeCell:
+    def __init__(self, system, uid, path):
+        self.system = system
+        self.uid = uid
+        self.path = path
+
+
+def test_stale_captured_parents_fall_back_to_fresh_derivation():
+    """A capture describes the LAST wake: an actor interned after it
+    must not inherit a stale 'collectable' verdict from the old mark
+    array — the resolver re-derives instead (review hardening)."""
+    from uigc_tpu.engines.crgc.arrays import ArrayShadowGraph
+    from uigc_tpu.engines.crgc.state import CrgcContext
+    from uigc_tpu.telemetry.inspect import (
+        snapshot_graph,
+        why_live_from_parents,
+    )
+
+    context = CrgcContext(delta_graph_size=64, entry_field_size=4)
+    system = _FakeSystem("uigc://fake")
+    graph = ArrayShadowGraph(context, system.address)
+    F = trace_ops
+    a = _FakeCell(system, 1, "/user/a")
+    b = _FakeCell(system, 2, "/user/b")
+    sa, sb = graph.slot_for(a), graph.slot_for(b)
+    graph.flags[sa] |= F.FLAG_ROOT | F.FLAG_INTERNED | F.FLAG_LOCAL
+    graph.flags[sb] |= F.FLAG_INTERNED | F.FLAG_LOCAL
+    graph._update_edge(sa, sb, 1)
+    graph.capture_parents = True
+    graph.trace(should_kill=False)
+    assert graph.last_parents is not None
+
+    # c interns AFTER the capture, retained by a fresh edge from a.
+    c = _FakeCell(system, 3, "/user/c")
+    sc = graph.slot_for(c)
+    graph.flags[sc] |= F.FLAG_INTERNED | F.FLAG_LOCAL
+    graph._update_edge(sa, sc, 1)
+
+    snap = snapshot_graph(graph, node=system.address)
+    result = why_live_from_parents(graph, snap, "/user/c")
+    assert result is not None
+    assert result["verdict"] == "live", result
+    assert result["parents"] == "derived", result  # not the stale capture
+    assert validate_why_live(snap, result) == []
+    # the untouched actor still resolves through the capture
+    kept = why_live_from_parents(graph, snap, "/user/b")
+    assert kept["verdict"] == "live" and kept["parents"] == "captured"
+
+
+# ------------------------------------------------------------------- #
+# Snapshot safety + flight recorder + watchdog
+# ------------------------------------------------------------------- #
+
+
+def test_snapshot_under_concurrent_fold_is_safe():
+    kit = ActorTestKit(
+        config={
+            "uigc.crgc.wakeup-interval": 5,
+            "uigc.telemetry.inspect": True,
+        },
+        name="folderkit",
+    )
+    rng = random.Random(4)
+    errors = []
+    try:
+        root = kit.spawn(
+            Behaviors.setup_root(lambda ctx: _ChurnRoot(ctx, rng, 60)),
+            "root",
+        )
+        insp = kit.system.telemetry.inspector
+        stop = threading.Event()
+
+        def churn():
+            while not stop.is_set():
+                root.tell(_Ping())
+                time.sleep(0.002)
+
+        thread = threading.Thread(target=churn)
+        thread.start()
+        try:
+            for _ in range(60):
+                try:
+                    snap = insp.snapshot()
+                    assert isinstance(snap["actors"], dict)
+                    assert isinstance(snap["edges"], list)
+                    # a why-live mid-churn must not raise either
+                    insp.why_live("root")
+                except Exception as exc:  # pragma: no cover
+                    errors.append(exc)
+                time.sleep(0.004)
+        finally:
+            stop.set()
+            thread.join()
+        assert errors == []
+    finally:
+        kit.shutdown()
+
+
+def test_flight_recorder_ring_and_diff():
+    recorder = FlightRecorder(keep=3)
+    mk = lambda actors, wave: {
+        "actors": {
+            k: {"recv_count": 0, "busy": False, "root": False,
+                "pseudoroot": False, "halted": False}
+            for k in actors
+        },
+        "edges": [],
+        "wave": wave,
+    }
+    recorder.record(mk(["a", "b"], 1))
+    recorder.record(mk(["b", "c"], 2))
+    diffs = recorder.diffs()
+    assert diffs[-1]["added"] == ["c"]
+    assert diffs[-1]["removed"] == ["a"]
+    assert diffs[-1]["retained"] == 1
+    for wave in range(3, 8):
+        recorder.record(mk(["x"], wave))
+    assert len(recorder.snapshots()) == 3  # ring bound
+    doc = recorder.to_json()
+    assert doc["versions"] == 7
+
+
+def test_leak_watchdog_flags_planted_leak_without_false_positives():
+    kit, root = _chain_kit(
+        extra={"uigc.telemetry.leak-waves": 3}, name="leakkit"
+    )
+    try:
+        insp = kit.system.telemetry.inspector
+        # Phase 1: let the system sit quiet until the planted leak is
+        # flagged (>= leak-waves zero-traffic waves).
+        deadline = time.monotonic() + 15.0
+        flagged = []
+        while time.monotonic() < deadline:
+            time.sleep(0.03)
+            snap = insp.snapshot()
+            flagged = [
+                snap["actors"].get(key, {}).get("name", key)
+                for key in insp.watchdog.suspects()
+            ]
+            if any(name.endswith("leaked") for name in flagged):
+                break
+        assert any(name.endswith("leaked") for name in flagged), flagged
+        # Phase 2: traffic re-arms the watchdog — while the workers are
+        # continuously messaged they must drop OUT of the suspect set
+        # (the zero-false-positive bar for active actors), while the
+        # zero-traffic leak stays flagged.
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            root.tell(_Ping())
+            time.sleep(0.008)
+            snap = insp.snapshot()
+            flagged = [
+                snap["actors"].get(key, {}).get("name", key)
+                for key in insp.watchdog.suspects()
+            ]
+            if not any("/w" in name for name in flagged) and any(
+                name.endswith("leaked") for name in flagged
+            ):
+                break
+        assert any(name.endswith("leaked") for name in flagged), flagged
+        assert not any("/w" in name for name in flagged), flagged
+        assert insp.leak_suspects_total >= 1
+    finally:
+        kit.shutdown()
+
+
+def test_leak_suspect_event_and_metric():
+    kit, root = _chain_kit(
+        extra={
+            "uigc.telemetry.leak-waves": 2,
+            "uigc.telemetry.metrics": True,
+        },
+        name="leakmetrics",
+    )
+    try:
+        registry = kit.system.telemetry.registry
+        deadline = time.monotonic() + 10.0
+        total = 0.0
+        while time.monotonic() < deadline and total == 0.0:
+            time.sleep(0.05)
+            total = registry.counter("uigc_leak_suspects_total").value()
+        assert total >= 1.0
+    finally:
+        kit.shutdown()
+
+
+# ------------------------------------------------------------------- #
+# Exporter satellites
+# ------------------------------------------------------------------- #
+
+
+def test_jsonl_rotation_keeps_bounded_set_and_replays_in_order(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlEventSink(path, max_bytes=2000, keep=2)
+    for i in range(400):
+        sink("test.event", {"i": i})
+    sink.close()
+    files = sorted(os.listdir(tmp_path))
+    assert "events.jsonl" in files
+    assert "events.jsonl.1" in files and "events.jsonl.2" in files
+    assert "events.jsonl.3" not in files  # oldest dropped
+    for name in files:
+        assert os.path.getsize(tmp_path / name) <= 2100
+    seq = [fields["i"] for name, fields in replay_jsonl(path)
+           if name == "test.event"]
+    # ordered stream across the rotated set, ending at the newest event
+    assert seq == sorted(seq)
+    assert seq[-1] == 399
+    assert len(seq) >= 3
+
+
+def test_jsonl_rotation_off_by_default(tmp_path):
+    path = str(tmp_path / "plain.jsonl")
+    sink = JsonlEventSink(path)
+    for i in range(100):
+        sink("test.event", {"i": i})
+    sink.close()
+    assert sorted(os.listdir(tmp_path)) == ["plain.jsonl"]
+    assert len(list(replay_jsonl(path))) == 100
+
+
+def test_healthz_wake_phase_histograms_and_inspect_endpoints():
+    kit, root = _chain_kit(
+        extra={
+            "uigc.telemetry.metrics": True,
+            "uigc.telemetry.wake-profile": True,
+            "uigc.telemetry.http-port": 0,
+        },
+        name="httpkit",
+    )
+    try:
+        port = kit.system.telemetry.http.port
+        base = f"http://127.0.0.1:{port}"
+        for _ in range(5):
+            root.tell(_Ping())
+            time.sleep(0.03)
+        health = json.loads(
+            urllib.request.urlopen(base + "/healthz", timeout=5).read()
+        )
+        assert health["status"] == "ok"
+        assert health["node"] == kit.system.address
+        deadline = time.monotonic() + 10.0
+        text = ""
+        while time.monotonic() < deadline:
+            text = urllib.request.urlopen(
+                base + "/metrics", timeout=5
+            ).read().decode()
+            if 'uigc_wake_phase_seconds_bucket{' in text:
+                break
+            time.sleep(0.05)
+        assert 'phase="trace"' in text
+        assert 'phase="ingest"' in text
+        snap = json.loads(
+            urllib.request.urlopen(base + "/snapshot", timeout=5).read()
+        )
+        assert snap["actors"]
+        kept = _key_of(snap, "kept")
+        assert kept is not None
+        result = json.loads(
+            urllib.request.urlopen(
+                base + "/inspect?actor=" + urllib.parse.quote(kept),
+                timeout=5,
+            ).read()
+        )
+        assert result["verdict"] in ("live", "collectable")
+        if result["verdict"] == "live":
+            assert validate_why_live(snap, result) == []
+    finally:
+        kit.shutdown()
+
+
+# ------------------------------------------------------------------- #
+# Cross-node: codec + merged snapshot + dropped frame
+# ------------------------------------------------------------------- #
+
+
+def test_snap_frame_codec_roundtrip_and_malformed():
+    req = wire.encode_snap_request(7, "nodeA")
+    assert wire.decode_snap_frame(req) == ("req", 7, "nodeA", None)
+    rsp = wire.encode_snap_response(7, "nodeB", b'{"actors": {}}')
+    assert wire.decode_snap_frame(rsp) == ("rsp", 7, "nodeB", b'{"actors": {}}')
+    # trailing-element tolerance
+    assert wire.decode_snap_frame(req + ("future",))[0] == "req"
+    # malformed shapes decode to None, never raise
+    assert wire.decode_snap_frame(("snap",)) is None
+    assert wire.decode_snap_frame(("snap", "rsp", 1, "x", "notbytes")) is None
+    assert wire.decode_snap_frame(("snap", "bogus", 1)) is None
+
+
+def _spawn_node(name, num_nodes, fault_plan=None, overrides=None):
+    config = {
+        "uigc.crgc.wakeup-interval": 10,
+        "uigc.crgc.egress-finalize-interval": 5,
+        "uigc.crgc.num-nodes": num_nodes,
+        "uigc.telemetry.inspect": True,
+    }
+    if overrides:
+        config.update(overrides)
+    fabric = NodeFabric(fault_plan=fault_plan)
+    system = ActorSystem(None, name=name, config=config, fabric=fabric)
+    port = fabric.listen()
+    return fabric, system, port
+
+
+def _terminate_all(*systems):
+    for system in systems:
+        try:
+            system.terminate(timeout_s=5.0)
+        except Exception:
+            pass
+
+
+def test_two_node_merged_snapshot_and_seeded_snap_drop():
+    fa, sa, _pa = _spawn_node("snapa", 2)
+    fb, sb, pb = _spawn_node("snapb", 2)
+    try:
+        fa.connect("127.0.0.1", pb)
+        root_b = sb.spawn_root(Behaviors.setup_root(_ChainRoot), "root")
+        root_a = sa.spawn_root(Behaviors.setup_root(_ChainRoot), "root")
+        root_b.tell(_Give(None))
+        root_a.tell(_Give(None))
+        time.sleep(0.4)
+        insp_a = sa.telemetry.inspector
+        deadline = time.monotonic() + 15.0
+        merged = {}
+        while time.monotonic() < deadline:
+            merged = insp_a.merged_snapshot(timeout_s=3.0)
+            locations = {
+                rec.get("location")
+                for rec in merged["actors"].values()
+            }
+            if sa.address in locations and sb.address in locations and (
+                not merged["missing_nodes"]
+            ):
+                break
+            time.sleep(0.1)
+        assert not merged["missing_nodes"], merged["missing_nodes"]
+        locations = {rec.get("location") for rec in merged["actors"].values()}
+        assert sa.address in locations and sb.address in locations
+        # B's kept actor is explainable from A's merged view
+        kept_b = None
+        for key, rec in merged["actors"].items():
+            if rec.get("name", "").endswith("kept") and (
+                rec.get("location") == sb.address
+            ):
+                kept_b = key
+        assert kept_b is not None
+        result = why_live(merged, kept_b)
+        assert result["verdict"] == "live", result
+        assert validate_why_live(merged, result) == []
+
+        # Seeded drop: every further "snap" frame from A's peer dies on
+        # the wire — the merge degrades to a partial graph that NAMES
+        # the missing node instead of hanging or raising.
+        fa.fault_plan = FaultPlan(seed=1).drop(kind="snap", prob=1.0)
+        fb.fault_plan = FaultPlan(seed=1).drop(kind="snap", prob=1.0)
+        partial = insp_a.merged_snapshot(timeout_s=1.0)
+        assert partial["missing_nodes"] == [sb.address]
+        locations = {
+            rec.get("location") for rec in partial["actors"].values()
+        }
+        assert sa.address in locations
+    finally:
+        _terminate_all(sa, sb)
+
+
+def test_merge_snapshots_prefers_home_records():
+    a = {
+        "node": "A",
+        "actors": {
+            "A#1": {"name": "x", "local": True, "pseudoroot": True,
+                    "halted": False, "recv_count": 0, "busy": False,
+                    "root": True, "interned": True, "location": "A"},
+            "B#2": {"name": "y", "local": False, "pseudoroot": False,
+                    "halted": False, "recv_count": 0, "busy": False,
+                    "root": False, "interned": False, "location": "B"},
+        },
+        "edges": [["A#1", "B#2", 1]],
+        "supervisors": [],
+        "send_matrix": [["A#1", "B#2", 5]],
+    }
+    b = {
+        "node": "B",
+        "actors": {
+            "B#2": {"name": "y", "local": True, "pseudoroot": False,
+                    "halted": False, "recv_count": 0, "busy": False,
+                    "root": False, "interned": True, "location": "B"},
+        },
+        "edges": [],
+        "supervisors": [],
+        "send_matrix": [],
+    }
+    merged = merge_snapshots([a, b], missing=["C"])
+    assert merged["actors"]["B#2"]["local"]  # home record won
+    assert merged["actors"]["B#2"]["reported_by"] == "B"
+    assert merged["missing_nodes"] == ["C"]
+    assert merged["send_matrix"] == [["A#1", "B#2", 5]]
+    result = why_live(merged, "B#2")
+    assert result["verdict"] == "live"
+    assert [h["kind"] for h in result["path"]] == ["created"]
+
+
+# ------------------------------------------------------------------- #
+# UL008 lint contract
+# ------------------------------------------------------------------- #
+
+
+def _lint(paths):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import uigc_lint
+
+    return uigc_lint.lint_paths(paths, lint_asserts=False)
+
+
+def test_ul008_real_inspect_module_is_clean():
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    target = os.path.join(repo, "uigc_tpu", "telemetry", "inspect.py")
+    violations = [v for v in _lint([target]) if v.rule == "UL008"]
+    assert violations == [], [v.render() for v in violations]
+
+
+def test_ul008_flags_mutating_inspect_code(tmp_path):
+    bad_dir = tmp_path / "telemetry"
+    bad_dir.mkdir()
+    bad = bad_dir / "inspect.py"
+    bad.write_text(
+        "from ..engines.crgc import arrays\n"
+        "def poke(graph, cell):\n"
+        "    graph.flags[0] = 0\n"
+        "    graph.capture_parents = True\n"
+        "    graph.trace(should_kill=True)\n"
+        "    cell.tell(object())\n"
+        "def fine(self_like):\n"
+        "    out = {}\n"
+        "    out['x'] = 1\n"
+        "    return out\n"
+    )
+    violations = [v for v in _lint([str(bad)]) if v.rule == "UL008"]
+    lines = {v.line for v in violations}
+    assert 1 in lines  # runtime engines import
+    assert 3 in lines  # graph.flags[0] = 0
+    assert 4 in lines  # graph.capture_parents = ...
+    assert 5 in lines  # .trace(...)
+    assert 6 in lines  # .tell(...)
+    assert all(v.line != 9 for v in violations)  # local dict store is fine

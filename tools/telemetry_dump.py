@@ -133,17 +133,62 @@ def dump_snapshot(path: str, fmt: str) -> int:
     return 0
 
 
+def dump_inspect(path, actor, fmt) -> int:
+    """Pretty-print a liveness-inspector snapshot (and optionally one
+    why-live retaining path): from a dumped JSON file when ``path`` is
+    given, else from a live in-process demo system — the rendering is
+    shared with tools/graph_inspect.py."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import graph_inspect
+
+    from uigc_tpu.telemetry.inspect import why_live
+
+    if path:
+        snap = graph_inspect.load_snapshot(path)
+        result = why_live(snap, actor) if actor else None
+    else:
+        demo = graph_inspect.DemoSystem()
+        try:
+            snap = demo.inspector.snapshot()
+            result = demo.inspector.why_live(actor) if actor else None
+        finally:
+            demo.shutdown()
+    if fmt == "json":
+        doc = {"snapshot": snap}
+        if result is not None:
+            doc["why_live"] = result
+        print(json.dumps(doc, indent=2, sort_keys=True, default=repr))
+    else:
+        print(graph_inspect.render_snapshot(snap))
+        if result is not None:
+            print(graph_inspect.render_why_live(result))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="telemetry-dump", description=__doc__.splitlines()[0]
     )
-    source = parser.add_mutually_exclusive_group(required=True)
+    source = parser.add_mutually_exclusive_group()
     source.add_argument("--from-jsonl", metavar="PATH", help="replay a JSONL event log")
     source.add_argument(
         "--demo", action="store_true", help="run a tiny workload and dump its metrics"
     )
     source.add_argument(
         "--snapshot", metavar="PATH", help="render a saved recorder snapshot JSON"
+    )
+    source.add_argument(
+        "--inspect",
+        nargs="?",
+        const="",
+        metavar="SNAPJSON",
+        default=None,
+        help="pretty-print a liveness snapshot (from SNAPJSON when "
+        "given, else from a live demo system); combine with --actor "
+        "for a why-live path (tools/graph_inspect.py)",
+    )
+    parser.add_argument(
+        "--actor", metavar="NAME", help="actor to explain with --inspect"
     )
     parser.add_argument(
         "--format",
@@ -152,11 +197,18 @@ def main(argv=None) -> int:
         help="output format (default: prom)",
     )
     args = parser.parse_args(argv)
+    if args.inspect is not None:
+        return dump_inspect(args.inspect, args.actor, args.format)
     if args.from_jsonl:
         return dump_from_jsonl(args.from_jsonl, args.format)
     if args.snapshot:
         return dump_snapshot(args.snapshot, args.format)
-    return dump_demo(args.format)
+    if args.demo:
+        return dump_demo(args.format)
+    parser.error(
+        "one of --from-jsonl / --demo / --snapshot / --inspect is required"
+    )
+    return 2
 
 
 if __name__ == "__main__":

@@ -71,6 +71,21 @@ UL007  socket-io-under-peer-lock
     on the per-peer writer thread, off-lock.  Grandfathered nowhere —
     new occurrences always fail ``--strict``.
 
+UL008  inspector-mutates-engine-state
+    Snapshot/inspect code (``uigc_tpu/telemetry/inspect.py``) broke its
+    read-only contract.  The liveness inspector observes the collector's
+    graph from foreign threads (HTTP handlers, link receive threads, the
+    CLI); any mutation it performed would race the collector fold and
+    corrupt liveness state — so the module must not (a) import
+    ``uigc_tpu.engines``/``uigc_tpu.runtime`` at runtime (TYPE_CHECKING-
+    gated imports are fine: duck-typed access only), (b) store through an
+    attribute whose root object is not ``self`` (its own recorder/
+    watchdog state is fair game, a graph/cell/system handle is not), or
+    (c) call an engine mutator (``trace``/``merge_*``/``start_wave``/
+    ``tell``/``stop``/``collect``/``send_frame``/...).  Capture
+    *enablement* is engine state and lives with the engine
+    (``Telemetry.attach``, ``engines/crgc/collector.py``).
+
 Suppression
 ===========
 
@@ -104,6 +119,36 @@ RULES = {
     "UL005": "inconsistent lock-acquisition order",
     "UL006": "direct ProxyCell construction outside runtime/",
     "UL007": "blocking socket call while holding a _PeerState lock",
+    "UL008": "snapshot/inspect code mutates engine state",
+}
+
+#: engine/collector mutators the read-only inspector must never call
+#: (UL008).  Local containers (dict.pop, list.append, deque, events
+#: commits) are deliberately absent — the rule targets the GC plane.
+_ENGINE_MUTATORS = {
+    "merge_entry",
+    "merge_entries",
+    "merge_packed",
+    "merge_delta",
+    "merge_undo_log",
+    "trace",
+    "harvest_trace",
+    "launch_trace",
+    "expire_stalled_wake",
+    "start_wave",
+    "tell",
+    "tell_bulk",
+    "tell_system",
+    "tell_batch",
+    "stop",
+    "collect",
+    "spawn",
+    "release",
+    "register_frame_handler",
+    "send_frame",
+    "die",
+    "link",
+    "attach_packed_plane",
 }
 
 #: method names that hit the network (or block on it) — the UL007 set.
@@ -228,9 +273,104 @@ class _FileLinter:
                 self._lint_proxycell(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
+        if self.path.replace(os.sep, "/").endswith("telemetry/inspect.py"):
+            self._lint_inspect_readonly()
         if lint_asserts:
             self._lint_asserts()
         self._collect_lock_pairs()
+
+    def _lint_inspect_readonly(self) -> None:
+        """UL008: the liveness inspector is read-only by contract."""
+
+        def import_names(node) -> List[str]:
+            if isinstance(node, ast.Import):
+                return [alias.name for alias in node.names]
+            if isinstance(node, ast.ImportFrom):
+                module = node.module or ""
+                # Relative imports: from ..engines.x / from ..runtime
+                # resolve inside uigc_tpu; absolute spell it out.
+                return [module]
+            return []
+
+        def is_type_checking_if(node: ast.AST) -> bool:
+            if not isinstance(node, ast.If):
+                return False
+            test = node.test
+            name = (
+                test.id
+                if isinstance(test, ast.Name)
+                else getattr(test, "attr", "")
+            )
+            return name == "TYPE_CHECKING"
+
+        def walk_imports(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if is_type_checking_if(child):
+                    continue  # annotation-only: never executes
+                if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    for module in import_names(child):
+                        parts = module.split(".")
+                        if "engines" in parts or "runtime" in parts:
+                            self.add(
+                                child.lineno,
+                                "UL008",
+                                f"runtime import of {module or '(relative)'!r}: "
+                                "inspect code reaches engine/runtime state "
+                                "duck-typed only (TYPE_CHECKING imports OK)",
+                            )
+                else:
+                    walk_imports(child)
+
+        def store_root(target: ast.AST):
+            """(root name, crosses-an-attribute?) of a store target."""
+            has_attr = False
+            node = target
+            while isinstance(node, (ast.Attribute, ast.Subscript)):
+                if isinstance(node, ast.Attribute):
+                    has_attr = True
+                node = node.value
+            if isinstance(node, ast.Name):
+                return node.id, has_attr
+            return None, has_attr
+
+        def check_target(target: ast.AST, line: int) -> None:
+            if isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    check_target(elt, line)
+                return
+            root, has_attr = store_root(target)
+            if has_attr and root is not None and root != "self":
+                self.add(
+                    line,
+                    "UL008",
+                    f"store through attribute of {root!r}: inspect code "
+                    "may only mutate its own objects (root must be self)",
+                )
+
+        walk_imports(self.tree)
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for target in targets:
+                    check_target(target, node.lineno)
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    check_target(target, node.lineno)
+            elif isinstance(node, ast.Call):
+                qual, name = _call_name(node)
+                if name in _ENGINE_MUTATORS and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    self.add(
+                        node.lineno,
+                        "UL008",
+                        f"call to engine mutator .{name}() from read-only "
+                        "inspect code",
+                    )
 
     def _lint_socket_under_peer_lock(self, fn: ast.AST) -> None:
         """UL007: blocking socket I/O under a _PeerState lock.

@@ -205,6 +205,39 @@ DEFAULTS: Dict[str, Any] = {
     # (replayable offline into RaceDetector.feed() and the violation
     # summaries; see uigc_tpu/telemetry/exporter.py).  "" disables.
     "uigc.telemetry.jsonl-path": "",
+    # Size-capped rotation for the JSONL sink: when the live file would
+    # exceed this many bytes it rotates to <path>.1 (shifting the set,
+    # keeping jsonl-keep rotated files) — long chaos runs hold at most
+    # (keep+1)*max bytes of events.  0 disables rotation (unbounded,
+    # the pre-rotation behavior).  replay_jsonl reads a rotated set
+    # oldest-first as one ordered stream.
+    "uigc.telemetry.jsonl-max-bytes": 0,
+    "uigc.telemetry.jsonl-keep": 3,
+    # Liveness inspector (uigc_tpu/telemetry/inspect.py): why-live
+    # retaining paths, flight-recorder snapshots and the cross-node
+    # merged graph ("snap" NodeFabric frames + /inspect and /snapshot
+    # on the metrics HTTP server), and the leak watchdog emitting
+    # telemetry.leak_suspect events.  Enables the event recorder.
+    "uigc.telemetry.inspect": False,
+    # Collector waves between automatic flight-recorder snapshots;
+    # 0 = only on demand / on crash.  (The leak watchdog samples every
+    # wave regardless while the inspector is attached.)
+    "uigc.telemetry.snapshot-every": 0,
+    # Snapshots retained in the flight-recorder ring.
+    "uigc.telemetry.snapshot-keep": 8,
+    # Consecutive zero-traffic collection waves after which the
+    # watchdog flags an actor as a leak suspect; 0 disables the
+    # watchdog.
+    "uigc.telemetry.leak-waves": 3,
+    # Capture the marking-parent array on every trace (verdict-exact
+    # why-live provenance).  Off, why-live queries derive parents on
+    # demand and the wake path runs the parent-free kernels — plain
+    # wakes pay nothing (the stats-variant gating discipline).
+    "uigc.telemetry.why-live-capture": False,
+    # Crash/teardown dump path for the flight recorder ("" disables):
+    # on NodeFabric crash injection and on telemetry close, the ring +
+    # a final snapshot are written here as one JSON document.
+    "uigc.telemetry.inspect-dump-path": "",
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,

@@ -80,6 +80,26 @@ class ArrayShadowGraph:
         #: metrics-only or sanitizer-only telemetry setups never pay
         #: the stats variant on the wake path.
         self.sweep_stats = False
+        #: capture the marking-parent array on the next trace (the
+        #: why-live provenance forest, telemetry/inspect.py).  Gated
+        #: exactly like ``sweep_stats``: the collector sets it per wake
+        #: only when a liveness inspector asked for verdict-exact
+        #: capture, so plain wakes run the parent-free kernels and pay
+        #: nothing.
+        self.capture_parents = False
+        #: (mark, parent) of the last captured trace: ``last_parents[i]``
+        #: is the slot whose propagation first marked slot ``i`` at that
+        #: verdict, -1 for pseudoroot seeds/unmarked.  Slots on a parent
+        #: chain are all marked, so the sweep that follows the capture
+        #: never frees a slot the chain names.
+        self.last_parents: Optional[np.ndarray] = None
+        self.last_parents_mark: Optional[np.ndarray] = None
+        #: accumulated per-edge send matrix: packed (src << 32 | dst)
+        #: slot key -> messages sent since enablement.  None (default)
+        #: = off; the liveness inspector's attach enables it by
+        #: assigning a dict.  Fed by every fold plane; rows naming a
+        #: swept slot are purged with the slot.
+        self.send_matrix: Optional[Dict[int, int]] = None
         #: per-wake closure+repair detection relative to the previous
         #: fixpoint (ops/pallas_decremental.py) instead of a full
         #: re-trace from seeds; works in interpret mode too, so it is
@@ -334,6 +354,7 @@ class ArrayShadowGraph:
             child_slot = self.slot_for(child.target)
             self._set_supervisor(child_slot, self_slot)
 
+        sm = self.send_matrix
         for i in range(field_size):
             target = entry.updated_refs[i]
             if target is None:
@@ -344,6 +365,9 @@ class ArrayShadowGraph:
             if send_count > 0:
                 self.recv_count[target_slot] -= send_count
                 self._touch(target_slot)
+                if sm is not None:
+                    key = (self_slot << 32) | target_slot
+                    sm[key] = sm.get(key, 0) + send_count
             if not refob_info.is_active(info):
                 self._update_edge(self_slot, target_slot, -1)
 
@@ -362,6 +386,7 @@ class ArrayShadowGraph:
         net no-ops — the same argument slotmap.fold_log documents)."""
         slot_for = self.slot_for
         slot_of_get = self.slot_of.get
+        sm = self.send_matrix
 
         self_slots: List[int] = []
         busyroot: List[int] = []
@@ -428,6 +453,9 @@ class ArrayShadowGraph:
                     rows_append(target_slot)
                     br_append(-1)  # recv-only row
                     rd_append(-send_count)
+                    if sm is not None:
+                        key = (self_slot << 32) | target_slot
+                        sm[key] = sm.get(key, 0) + send_count
                 if info & 1:  # deactivated (refob_info.is_active == False)
                     ek_append((self_slot << 32) | target_slot)
                     es_append(-1)
@@ -645,6 +673,12 @@ class ArrayShadowGraph:
         has_send = send > 0
         deact = (uiv & 1) == 1
 
+        sm = self.send_matrix
+        if sm is not None and has_send.any():
+            skeys = (upd_self[has_send] << 32) | ut_s[has_send]
+            for key, count in zip(skeys.tolist(), send[has_send].tolist()):
+                sm[key] = sm.get(key, 0) + count
+
         sl = np.concatenate([self_slots, ut_s[has_send]])
         brr = np.concatenate([br, np.full(int(has_send.sum()), -1, np.int64)])
         rdd = np.concatenate([recv, -send[has_send]])
@@ -818,6 +852,43 @@ class ArrayShadowGraph:
                 self.edge_weight[:eh],
             )
         return mark
+
+    def _compute_marks_with_parents(self) -> np.ndarray:
+        """Mark fixpoint with why-live parent capture: stores the
+        (mark, parent) pair on ``last_parents``/``last_parents_mark``
+        and returns the marks.  Marks are bit-identical to
+        :meth:`compute_marks` (parity-tested against both kernels), so
+        the sweep that consumes them is unchanged.  The device form is
+        one extra XLA fixpoint (ops/pallas_trace.py marking_parents_jax
+        — the mark MXU kernel cannot attribute sources); the host form
+        is the numpy scatter-min twin.  Reached only when
+        ``capture_parents`` was set for this wake."""
+        if self.use_device:
+            from ...ops import pallas_trace as _pt
+
+            with events.recorder.timed(events.DEVICE_TRACE) as ev:
+                ev.fields["trace_mode"] = self.trace_mode
+                ev.fields["capture_parents"] = True
+                mark, parent = _pt.marking_parents_jax(
+                    self.flags,
+                    self.recv_count,
+                    self.supervisor,
+                    self.edge_src,
+                    self.edge_dst,
+                    self.edge_weight,
+                )
+        else:
+            mark, parent = trace_ops.trace_marks_np_parents(
+                self.flags,
+                self.recv_count,
+                self.supervisor,
+                self.edge_src,
+                self.edge_dst,
+                self.edge_weight,
+            )
+        self.last_parents = np.asarray(parent)
+        self.last_parents_mark = np.asarray(mark)
+        return np.asarray(mark)
 
     def _on_tpu(self) -> bool:
         tpu = getattr(self, "_is_tpu", None)
@@ -1062,7 +1133,10 @@ class ArrayShadowGraph:
         # (garbage is monotone).
         self._pending_wake = None
         with events.recorder.timed(events.TRACING) as ev:
-            mark = self.compute_marks()
+            if self.capture_parents:
+                mark = self._compute_marks_with_parents()
+            else:
+                mark = self.compute_marks()
             # The sweep (kill decisions + slot frees) nests in its own
             # timed event so the wake profiler can attribute
             # trace-vs-sweep time (telemetry/profile.py).
@@ -1156,6 +1230,19 @@ class ArrayShadowGraph:
                     pop(uid, None)
         self._br_seq[garbage_slots] = -1
         self._sup_seq[garbage_slots] = -1
+
+        sm = self.send_matrix
+        if sm:
+            # Traffic rows naming a swept slot die with it: a freed slot
+            # may re-intern a different actor, and a proven-garbage
+            # actor's history is useless to placement.
+            dead_keys = [
+                key
+                for key in sm
+                if garbage[key >> 32] or garbage[key & 0xFFFFFFFF]
+            ]
+            for key in dead_keys:
+                del sm[key]
 
         cells = self.cells
         locations = self.locations

@@ -333,12 +333,22 @@ class Bookkeeper(RawBehavior):
         tel = engine.system.telemetry
         tracer = tel.tracer if tel is not None and tel.tracer.enabled else None
         prof = engine.wake_profiler
+        insp = engine.liveness_inspector
         wake = prof.begin_wake() if prof is not None else None
         if hasattr(self.shadow_graph, "sweep_stats"):
             # Device backends collect the per-sweep frontier stats only
             # when a profiler is attached to carry them (arrays.py
             # _stamp_sweep_stats -> WakeProfiler per-wake records).
             self.shadow_graph.sweep_stats = wake is not None
+        if hasattr(self.shadow_graph, "capture_parents"):
+            # Why-live parent capture follows the same gating discipline:
+            # only a liveness inspector that asked for verdict-exact
+            # provenance flips the graph onto the parents kernels — a
+            # plain wake never pays the capture fixpoint
+            # (telemetry/inspect.py).
+            self.shadow_graph.capture_parents = (
+                insp is not None and insp.parent_capture
+            )
         count = n_garbage = 0
         try:
             if tracer is not None:
@@ -355,6 +365,17 @@ class Bookkeeper(RawBehavior):
             # credited to a dead wake.
             if wake is not None:
                 wake.end(entries=count, garbage=n_garbage)
+        if insp is not None:
+            # Flight recorder + leak watchdog ride the collector thread
+            # (the one thread that owns the graph, so the read is
+            # fold-consistent).  Isolated like any listener: a broken
+            # inspector must not stall collection.
+            try:
+                insp.on_wake(self.shadow_graph, count, n_garbage)
+            except Exception:
+                events.recorder.commit(
+                    events.LISTENER_ERROR, listener="liveness_inspector"
+                )
         self._after_wake(n_garbage)
         return count
 

@@ -89,6 +89,18 @@ class ShadowGraph:
         self.total_actors_seen = 0
         self.from_set: List[Shadow] = []
         self.shadow_map: Dict["ActorCell", Shadow] = {}
+        #: why-live parent capture (telemetry/inspect.py), gated per wake
+        #: by the collector exactly like the array backend's flag: when
+        #: set, the next trace records ``last_parents`` — a
+        #: ``{cell: (parent_cell, kind)}`` map where ``kind`` is
+        #: "created" or "supervisor" and pseudoroot seeds are absent
+        #: (their explanation is their own flags).
+        self.capture_parents = False
+        self.last_parents: Optional[Dict[Any, tuple]] = None
+        #: accumulated per-edge send matrix ((owner_cell, target_cell)
+        #: -> messages sent); None = off, enabled by the liveness
+        #: inspector's attach.  Swept cells' rows are purged.
+        self.send_matrix: Optional[Dict[tuple, int]] = None
 
     # ------------------------------------------------------------- #
     # Shadow lookup
@@ -159,6 +171,7 @@ class ShadowGraph:
         # deactivations remove an outgoing edge.
         from . import refob as refob_info
 
+        sm = self.send_matrix
         for i in range(field_size):
             target = entry.updated_refs[i]
             if target is None:
@@ -168,6 +181,9 @@ class ShadowGraph:
             send_count = refob_info.count(info)
             if send_count > 0:
                 target_shadow.recv_count -= send_count  # may go negative
+                if sm is not None:
+                    key = (self_shadow.self_cell, target_shadow.self_cell)
+                    sm[key] = sm.get(key, 0) + send_count
             if not refob_info.is_active(info):
                 _update_outgoing(self_shadow.outgoing, target_shadow, -1)
 
@@ -226,6 +242,13 @@ class ShadowGraph:
         the subtree via the runtime's stop cascade
         (reference: ShadowGraph.java:205-289)."""
         marked = self.marked
+        # Why-live provenance (telemetry/inspect.py): when capture is on
+        # for this wake, record which shadow's propagation first marked
+        # each non-seed — the pointer-graph twin of the array backend's
+        # marking-parent array.
+        parents: Optional[Dict[Any, tuple]] = (
+            {} if self.capture_parents else None
+        )
         with events.recorder.timed(events.TRACING) as ev:
             to_set: List[Shadow] = []
             for shadow in self.from_set:
@@ -245,12 +268,22 @@ class ShadowGraph:
                     if count > 0 and target.mark != marked:
                         to_set.append(target)
                         target.mark = marked
+                        if parents is not None:
+                            parents[target.self_cell] = (
+                                owner.self_cell, "created",
+                            )
                 # Mark the supervisor so parents outlive descendants —
                 # deliberately incomplete (reference: ShadowGraph.java:242-267).
                 supervisor = owner.supervisor
                 if supervisor is not None and supervisor.mark != marked:
                     to_set.append(supervisor)
                     supervisor.mark = marked
+                    if parents is not None:
+                        parents[supervisor.self_cell] = (
+                            owner.self_cell, "supervisor",
+                        )
+            if parents is not None:
+                self.last_parents = parents
 
             num_garbage = 0
             num_live = 0
@@ -282,6 +315,16 @@ class ShadowGraph:
 
                 self.from_set = to_set
                 self.marked = not marked
+                sm = self.send_matrix
+                if sm and num_garbage:
+                    shadow_map = self.shadow_map
+                    dead_keys = [
+                        key
+                        for key in sm
+                        if key[0] not in shadow_map or key[1] not in shadow_map
+                    ]
+                    for key in dead_keys:
+                        del sm[key]
             ev.fields["num_garbage_actors"] = num_garbage
             ev.fields["num_live_actors"] = num_live
         return num_garbage

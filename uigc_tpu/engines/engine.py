@@ -87,6 +87,11 @@ class Engine:
         #: installed by Telemetry.attach; engines with a periodic
         #: collector consult it per wake.
         self.wake_profiler: Optional[Any] = None
+        #: optional liveness inspector (uigc_tpu/telemetry/inspect.py),
+        #: installed by Telemetry.attach; the collector feeds it one
+        #: read-only callback per wake (flight recorder, leak watchdog)
+        #: and consults ``parent_capture`` to gate why-live provenance.
+        self.liveness_inspector: Optional[Any] = None
 
     # -- Root-actor support ------------------------------------------- #
 

@@ -230,6 +230,101 @@ def jump_parents_from_graph(
     return jump_parents(psrc, pdst, n)
 
 
+# --------------------------------------------------------------------- #
+# Marking parents (why-live provenance; telemetry/inspect.py)
+#
+# The observability analogue of the jump-parent forest above: where
+# jump_parents is an ACCELERATION structure (min-source over raw pairs,
+# squared each sweep, free to over-shortcut), the marking-parent array is
+# an EXPLANATION structure — parent[i] is the node whose propagation
+# first marked i in a plain BFS fixpoint, so following parents from any
+# live actor walks a concrete pseudoroot→actor retaining path in which
+# every hop is a real positive-weight edge or supervisor pointer.  It is
+# computed by a separate scatter-min XLA fixpoint over the same flat
+# node/edge arrays the mark kernels consume, NOT inside the Pallas mark
+# kernel: the mark kernel's one-hot MXU contraction reduces sources to a
+# single OR bit per destination and cannot say *which* source fired, and
+# threading an argmin through it would double the streamed bytes of
+# every plain wake.  Keeping provenance in its own dispatch means the
+# no-capture wake path is untouched (stats-variant gating discipline)
+# and a capture costs exactly one extra device fixpoint.
+# --------------------------------------------------------------------- #
+
+_parents_fn_cache: Dict[str, object] = {}
+
+
+def _build_parents_fn():
+    import jax
+    import jax.numpy as jnp
+
+    F = trace_ops
+
+    def parents_fn(flags, recv_count, supervisor, edge_src, edge_dst,
+                   edge_weight):
+        n = flags.shape[0]
+        in_use = (flags & F.FLAG_IN_USE) != 0
+        halted = (flags & F.FLAG_HALTED) != 0
+        seed = (
+            ((flags & F.FLAG_ROOT) != 0)
+            | ((flags & F.FLAG_BUSY) != 0)
+            | (recv_count != 0)
+            | ((flags & F.FLAG_INTERNED) == 0)
+        )
+        mark0 = in_use & (~halted) & seed
+        parent0 = jnp.full(n, -1, dtype=jnp.int32)
+
+        live_edge = edge_weight > 0
+        edst = jnp.where(live_edge, edge_dst, n)
+        esrc = jnp.where(live_edge, edge_src, n).astype(jnp.int32)
+        sup_dst = jnp.where(supervisor >= 0, supervisor, n)
+        sup_src = jnp.arange(n, dtype=jnp.int32)
+
+        def cond(carry):
+            return carry[2]
+
+        def body(carry):
+            mark, parent, _ = carry
+            active = mark & (~halted)
+            active_pad = jnp.concatenate([active, jnp.zeros((1,), bool)])
+            # Scatter-min of the active source's own index per
+            # destination; slot n is the sink for dead edges/no-sup.
+            cand = jnp.full((n + 1,), n, dtype=jnp.int32)
+            cand = cand.at[edst].min(
+                jnp.where(active_pad[esrc], esrc, n)
+            )
+            cand = cand.at[sup_dst].min(
+                jnp.where(active, sup_src, n)
+            )
+            cand = cand[:n]
+            newly = (cand < n) & (~mark) & in_use
+            parent = jnp.where(newly, cand, parent)
+            return mark | newly, parent, jnp.any(newly)
+
+        mark, parent, _ = jax.lax.while_loop(
+            cond, body, (mark0, parent0, jnp.array(True))
+        )
+        return mark, parent
+
+    return jax.jit(parents_fn)
+
+
+def marking_parents_jax(flags, recv_count, supervisor, edge_src, edge_dst,
+                        edge_weight):
+    """Device (XLA) mark fixpoint with marking-parent capture.  Same
+    mark contract as ``trace_ops.trace_marks_jax``; additionally returns
+    ``parent`` (int32[n], -1 = pseudoroot seed or unmarked, else the
+    minimum source whose propagation first marked the slot) — matching
+    ``trace_ops.trace_marks_np_parents`` exactly, which is the parity
+    oracle.  Shapes are static; the jitted fn is cached process-wide."""
+    if "fn" not in _parents_fn_cache:
+        _parents_fn_cache["fn"] = _build_parents_fn()
+    fn = _parents_fn_cache["fn"]
+    mark, parent = fn(
+        flags, recv_count, supervisor, edge_src, edge_dst, edge_weight
+    )
+    return np.asarray(mark), np.asarray(parent)
+
+
 def bits_at(table, ids, n, jnp):
     """Gather per-node bits from a packed word table for an int32 id
     vector; ids >= n (the sentinel and any padding) read as 0."""

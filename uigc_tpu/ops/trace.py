@@ -93,6 +93,53 @@ def trace_marks_np(
         mark = new_mark
 
 
+def trace_marks_np_parents(
+    flags: np.ndarray,
+    recv_count: np.ndarray,
+    supervisor: np.ndarray,
+    edge_src: np.ndarray,
+    edge_dst: np.ndarray,
+    edge_weight: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host mark fixpoint that additionally records the marking-parent
+    array: ``parent[i]`` is the slot whose propagation first marked
+    ``i`` (the minimum such source within the marking sweep, matching
+    the device variant's scatter-min), or ``-1`` for pseudoroot seeds
+    and unmarked slots.  Marks are bit-identical to
+    :func:`trace_marks_np`; parents form an acyclic forest rooted at
+    the seeds — the raw material of a why-live retaining path
+    (telemetry/inspect.py).  A separate entry point, not a flag on the
+    plain trace, so the no-capture wake path pays nothing."""
+    n = flags.shape[0]
+    in_use = (flags & FLAG_IN_USE) != 0
+    halted = (flags & FLAG_HALTED) != 0
+    mark = pseudoroots_np(flags, recv_count)
+    parent = np.full(n, -1, dtype=np.int64)
+
+    live_edge = edge_weight > 0
+    esrc = edge_src[live_edge].astype(np.int64)
+    edst = edge_dst[live_edge].astype(np.int64)
+
+    has_sup = supervisor >= 0
+    sup_src = np.nonzero(has_sup)[0]
+    sup_dst = supervisor[sup_src].astype(np.int64)
+
+    while True:
+        active = mark & ~halted
+        cand = np.full(n, n, dtype=np.int64)
+        if esrc.size:
+            hit = active[esrc]
+            np.minimum.at(cand, edst[hit], esrc[hit])
+        if sup_src.size:
+            hit = active[sup_src]
+            np.minimum.at(cand, sup_dst[hit], sup_src[hit])
+        newly = (cand < n) & ~mark & in_use
+        if not newly.any():
+            return mark, parent
+        parent[newly] = cand[newly]
+        mark = mark | newly
+
+
 # --------------------------------------------------------------------- #
 # JAX implementation
 # --------------------------------------------------------------------- #

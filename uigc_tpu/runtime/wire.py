@@ -467,6 +467,50 @@ def decode_shard_grant(frame: tuple):
         return None
 
 
+# ------------------------------------------------------------------- #
+# Liveness-inspector snapshot frames (uigc_tpu/telemetry/inspect.py)
+#
+# One frame kind, two shapes, same tolerance contract as the cluster
+# frames above (trailing elements accepted, malformed -> None, unknown
+# kind ignored by old peers after seq accounting):
+#
+#   ("snap", "req", req_id, origin)           ask a peer for its snapshot
+#   ("snap", "rsp", req_id, origin, payload)  the JSON-encoded snapshot
+#
+# ``payload`` is UTF-8 JSON bytes of one telemetry.inspect snapshot
+# document; JSON (not pickle) deliberately — the receiver treats it as
+# data, so a malformed or malicious peer snapshot can at worst fail
+# json.loads, never execute.
+# ------------------------------------------------------------------- #
+
+SNAP_FRAME_KIND = "snap"
+
+
+def encode_snap_request(req_id: int, origin: str) -> tuple:
+    return ("snap", "req", int(req_id), origin)
+
+
+def encode_snap_response(req_id: int, origin: str, payload: bytes) -> tuple:
+    return ("snap", "rsp", int(req_id), origin, payload)
+
+
+def decode_snap_frame(frame: tuple):
+    """-> ("req", req_id, origin, None) | ("rsp", req_id, origin,
+    payload) | None."""
+    try:
+        kind = frame[1]
+        if kind == "req":
+            return "req", int(frame[2]), str(frame[3]), None
+        if kind == "rsp":
+            payload = frame[4]
+            if not isinstance(payload, bytes):
+                return None
+            return "rsp", int(frame[2]), str(frame[3]), payload
+        return None
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
 def encode_migration_ack(type_name: str, key: str, mig_id: tuple) -> tuple:
     return ("miga", type_name, key, tuple(mig_id))
 

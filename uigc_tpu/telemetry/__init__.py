@@ -14,8 +14,13 @@ attributed to a single GC wave.  This package is the subsystem on top
 - :mod:`uigc_tpu.telemetry.profile` — the collector wake profiler
   (ingest/fold/trace/sweep/broadcast phases, device-vs-host time);
 - :mod:`uigc_tpu.telemetry.exporter` — Prometheus text exposition over
-  a localhost HTTP handle, plus JSONL event persistence whose replay
-  feeds ``RaceDetector.feed()`` and the violation record offline.
+  a localhost HTTP handle, plus JSONL event persistence (size-capped
+  rotation) whose replay feeds ``RaceDetector.feed()`` and the
+  violation record offline;
+- :mod:`uigc_tpu.telemetry.inspect` — the liveness inspector: why-live
+  retaining paths from the marking-parent forest, flight-recorder
+  snapshots with retained-set diffing, the leak watchdog, and the
+  cross-node merged graph (read-only by the UL008 contract).
 
 Everything is off by default and attached per-system from the
 ``uigc.telemetry.*`` config keys; :class:`Telemetry` is the composition
@@ -34,6 +39,7 @@ from .exporter import (
     replay_jsonl,
     replay_violations,
 )
+from .inspect import FlightRecorder, LeakWatchdog, LivenessInspector
 from .metrics import EventMetricsBridge, MetricsRegistry, install_system_gauges
 from .profile import WakeProfiler
 from .tracing import Tracer, chrome_trace, write_chrome_trace
@@ -47,6 +53,9 @@ __all__ = [
     "EventMetricsBridge",
     "Tracer",
     "WakeProfiler",
+    "LivenessInspector",
+    "FlightRecorder",
+    "LeakWatchdog",
     "MetricsHTTPServer",
     "JsonlEventSink",
     "prometheus_text",
@@ -72,12 +81,15 @@ class Telemetry:
             system.address, enabled=config.get_bool("uigc.telemetry.tracing")
         )
         self.profiler: Optional[WakeProfiler] = None
+        self.inspector: Optional[LivenessInspector] = None
         self.http: Optional[MetricsHTTPServer] = None
         self.jsonl: Optional[JsonlEventSink] = None
         self._listeners: List[Any] = []
+        self._snap_frame_registered = False
 
         metrics_on = config.get_bool("uigc.telemetry.metrics")
         profile_on = config.get_bool("uigc.telemetry.wake-profile")
+        inspect_on = config.get_bool("uigc.telemetry.inspect")
         http_port = config.get_int("uigc.telemetry.http-port")
         jsonl_path = config.get_string("uigc.telemetry.jsonl-path")
 
@@ -88,22 +100,106 @@ class Telemetry:
             bridge = EventMetricsBridge(self.registry, node=system.address)
             self._listeners.append(bridge)
         if profile_on:
-            self.profiler = WakeProfiler(system.address)
+            # With a registry present the profiler also exports
+            # uigc_wake_phase_seconds{phase=...} histograms, not just
+            # its BENCH-JSON dump.
+            self.profiler = WakeProfiler(system.address, registry=self.registry)
             self._listeners.append(self.profiler)
             engine = getattr(system, "engine", None)
             if engine is not None:
                 engine.wake_profiler = self.profiler
+        if inspect_on:
+            self.inspector = self._attach_inspector()
         if jsonl_path:
-            self.jsonl = JsonlEventSink(jsonl_path)
+            self.jsonl = JsonlEventSink(
+                jsonl_path,
+                max_bytes=config.get_int("uigc.telemetry.jsonl-max-bytes"),
+                keep=config.get_int("uigc.telemetry.jsonl-keep"),
+            )
             self._listeners.append(self.jsonl)
         if http_port >= 0:
-            self.http = MetricsHTTPServer(self.registry, port=http_port)
+            self.http = MetricsHTTPServer(
+                self.registry,
+                port=http_port,
+                inspector=self.inspector,
+                node=system.address,
+            )
 
-        if self._listeners:
-            # Listener-fed parts need the process recorder live.
+        if self._listeners or self.inspector is not None:
+            # Listener-fed parts need the process recorder live (the
+            # inspector is a committer, not a listener, but its
+            # leak_suspect/snapshot events need the same).
             events.recorder.enable()
             for listener in self._listeners:
                 events.recorder.add_listener(listener)
+
+    def _attach_inspector(self) -> Optional[LivenessInspector]:
+        """Wire the liveness inspector: engine-side capture enablement
+        (the inspector itself is read-only by the UL008 contract, so
+        every mutation of engine/transport state happens HERE), the
+        collector's per-wake hook, and — on a NodeFabric — the "snap"
+        frame exchange behind the cross-node merged snapshot."""
+        system = self.system
+        config = system.config
+        engine = getattr(system, "engine", None)
+        bookkeeper = getattr(engine, "bookkeeper", None)
+        if bookkeeper is None:
+            return None  # engines without a collector graph (manual)
+        leak_waves = config.get_int("uigc.telemetry.leak-waves")
+        # Wall-clock floor on suspicion: N quiet waves AND idle for at
+        # least as long as N waves take, so millisecond collector
+        # cadences cannot outrun a workload's ordinary message gaps.
+        wakeup_s = config.get_int("uigc.crgc.wakeup-interval") / 1000.0
+        inspector = LivenessInspector(
+            node=system.address,
+            graph_fn=lambda: bookkeeper.shadow_graph,
+            snapshot_every=config.get_int("uigc.telemetry.snapshot-every"),
+            snapshot_keep=config.get_int("uigc.telemetry.snapshot-keep"),
+            leak_waves=leak_waves,
+            leak_min_idle_s=leak_waves * wakeup_s,
+            parent_capture=config.get_bool("uigc.telemetry.why-live-capture"),
+            dump_path=config.get_string("uigc.telemetry.inspect-dump-path"),
+        )
+        engine.liveness_inspector = inspector
+        # Enable the send-matrix accumulation on backends that carry it
+        # (the placement input, ROADMAP item 5) — a plain dict assigned
+        # from here, consulted by every fold plane.
+        graph = bookkeeper.shadow_graph
+        if hasattr(graph, "send_matrix") and graph.send_matrix is None:
+            graph.send_matrix = {}
+        # Crash dump: the fabric's crash event triggers a best-effort
+        # flight-recorder flush to the configured path.
+        if inspector.dump_path:
+            node = system.address
+
+            def _crash_listener(name: str, fields: Any) -> None:
+                if name == events.NODE_CRASHED and fields.get("address") == node:
+                    inspector.on_crash()
+
+            self._listeners.append(_crash_listener)
+        # Cross-node merge: register the "snap" frame on fabrics that
+        # speak custom frame kinds (NodeFabric).
+        fabric = getattr(system, "fabric", None)
+        if fabric is not None and hasattr(fabric, "register_frame_handler"):
+            from ..runtime import wire
+
+            def _snap_handler(from_address: str, frame: tuple) -> None:
+                decoded = wire.decode_snap_frame(frame)
+                if decoded is not None:
+                    inspector.on_snap_frame(from_address, *decoded)
+
+            fabric.register_frame_handler(wire.SNAP_FRAME_KIND, _snap_handler)
+            self._snap_frame_registered = True
+            inspector.bind_fabric(
+                peers_fn=fabric._live_peers,
+                send_request=lambda addr, rid: fabric.send_frame(
+                    addr, wire.encode_snap_request(rid, system.address)
+                ),
+                send_response=lambda addr, rid, payload: fabric.send_frame(
+                    addr, wire.encode_snap_response(rid, system.address, payload)
+                ),
+            )
+        return inspector
 
     # ------------------------------------------------------------- #
 
@@ -123,6 +219,20 @@ class Telemetry:
         engine = getattr(self.system, "engine", None)
         if engine is not None and engine.wake_profiler is self.profiler:
             engine.wake_profiler = None
+        if self.inspector is not None:
+            if self.inspector.dump_path:
+                self.inspector.on_crash(reason="close")
+            if engine is not None and (
+                engine.liveness_inspector is self.inspector
+            ):
+                engine.liveness_inspector = None
+            if self._snap_frame_registered:
+                fabric = getattr(self.system, "fabric", None)
+                if fabric is not None:
+                    from ..runtime import wire
+
+                    fabric.register_frame_handler(wire.SNAP_FRAME_KIND, None)
+            self.inspector = None
         if self.http is not None:
             self.http.close()
             self.http = None

@@ -402,6 +402,15 @@ class EventMetricsBridge:
             "uigc_fabric_lookup_miss_total",
             "Well-known-name lookups the peer's hello never resolved.",
         )
+        self._leak_suspects = r.counter(
+            "uigc_leak_suspects_total",
+            "Actors the liveness watchdog flagged (survived N waves "
+            "with zero traffic; telemetry/inspect.py).",
+        )
+        self._inspect_snapshots = r.counter(
+            "uigc_inspect_snapshots_total",
+            "Flight-recorder shadow-graph snapshots captured.",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -481,6 +490,10 @@ class EventMetricsBridge:
             self._state_conflicts.inc()
         elif name == events.LOOKUP_MISS:
             self._lookup_misses.inc()
+        elif name == events.LEAK_SUSPECT:
+            self._leak_suspects.inc()
+        elif name == events.SNAPSHOT:
+            self._inspect_snapshots.inc()
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:

@@ -115,10 +115,26 @@ class WakeProfiler:
     recorder listener (device/sweep attribution); both are done by
     :meth:`uigc_tpu.telemetry.Telemetry.attach`."""
 
-    def __init__(self, node: str, max_recent: int = 256):
+    def __init__(self, node: str, max_recent: int = 256, registry=None):
         self.node = node
         self._lock = threading.Lock()
         self._active: Optional[_Wake] = None
+        #: Prometheus face (optional): per-phase wake durations as one
+        #: histogram labelled by phase, plus the device share — so the
+        #: BENCH-JSON dump is no longer the only way to read the
+        #: profiler (uigc.telemetry.metrics + wake-profile together).
+        self._phase_hist = None
+        self._device_hist = None
+        if registry is not None:
+            self._phase_hist = registry.histogram(
+                "uigc_wake_phase_seconds",
+                "Exclusive time of one collector-wake phase, by phase "
+                "(ingest/fold/trace/sweep/broadcast).",
+            )
+            self._device_hist = registry.histogram(
+                "uigc_wake_device_seconds",
+                "Device-kernel share of one collector wake.",
+            )
         self._wakes = 0
         self._wall_total = 0.0
         self._wall_max = 0.0
@@ -152,6 +168,11 @@ class WakeProfiler:
             **wake.trace_fields,
             **fields,
         }
+        if self._phase_hist is not None:
+            for name in PHASES:
+                self._phase_hist.observe(phases[name], phase=name)
+            if self._device_hist is not None:
+                self._device_hist.observe(wake.device_s)
         with self._lock:
             self._wakes += 1
             self._wall_total += wall_s

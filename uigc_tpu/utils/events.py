@@ -146,7 +146,17 @@ SHARD_STATE_CONFLICT = "shard.state_conflict"
 #                             fields: listener, event, error.  Counted so
 #                             broken listeners are a metric, not just a
 #                             traceback scrolling past on stderr.
+#   telemetry.leak_suspect    the liveness inspector's watchdog flagged an
+#                             actor that survived N collection waves with
+#                             zero traffic (fields: actor, node, waves,
+#                             recv_count, retained_by); advisory — a
+#                             pointer to run `graph_inspect why-live`.
+#   telemetry.snapshot        the flight recorder captured a shadow-graph
+#                             snapshot (fields: node, wave, reason,
+#                             actors, edges).
 LISTENER_ERROR = "telemetry.listener_error"
+LEAK_SUSPECT = "telemetry.leak_suspect"
+SNAPSHOT = "telemetry.snapshot"
 
 #: Per-thread event origin (a node address).  The recorder is a process
 #: singleton; when several ActorSystems share one process (the
