@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""bench-check: regression gate over the committed BENCH_* trajectory.
+
+The repo commits one ``BENCH_<FAMILY>_rNN.json`` artifact per perf
+round (FABRIC/SHARD/FOLD/WAKE families).  This tool parses each
+family's trajectory, compares the newest run against the prior one
+with per-family tolerance bands, and exits nonzero with a readable
+delta table when a key metric regressed beyond its band — the cheap
+"did this PR quietly lose the 50k frames/s" check the verify pass runs.
+
+Semantics per metric direction:
+
+- ``higher``  throughput-style: FAIL when new < prior * (1 - tol)
+- ``lower``   latency-style:    FAIL when new > prior * (1 + tol)
+- ``zero``    correctness tally (undercounts): FAIL when new > prior
+
+A family with fewer than two committed runs is SKIPped (nothing to
+compare), as is a metric whose path stopped existing — bench shapes
+drift between rounds, and a missing key must read as "not comparable",
+never as a silent pass of something that regressed.  Paths resolve
+dotted (``link.batch.frames_per_sec``) with a one-level descent into
+nested round documents (the r04 FOLD shape wraps the payload under
+``"r4"``).
+
+``--check-regression FILE`` runs the self-test the suite uses: the
+given doctored newest-run copy must FAIL against the real trajectory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+class Metric:
+    __slots__ = ("path", "direction", "tolerance")
+
+    def __init__(self, path: str, direction: str, tolerance: float):
+        self.path = path
+        self.direction = direction
+        self.tolerance = tolerance
+
+
+#: family -> (glob pattern, key metrics).  Tolerances are wide on
+#: purpose: these runs come from whatever host the round ran on, and
+#: the gate exists to catch step-function losses, not 5% jitter.
+FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
+    "FABRIC": (
+        "BENCH_FABRIC_r*.json",
+        [
+            Metric("link.batch.frames_per_sec", "higher", 0.40),
+            Metric("teardown.actors_per_sec", "higher", 0.40),
+        ],
+    ),
+    "SHARD": (
+        "BENCH_SHARD_r*.json",
+        [
+            Metric("steady.messages_per_sec", "higher", 0.40),
+            Metric("post_rebalance_probe.undercounted_entities", "zero", 0.0),
+        ],
+    ),
+    "FOLD": (
+        "BENCH_FOLD_r*.json",
+        [
+            Metric("fold.packed.entries_per_sec", "higher", 0.40),
+            Metric("sweep.garbage_actors_per_sec", "higher", 0.40),
+        ],
+    ),
+    "WAKE": (
+        "BENCH_WAKE_r*.json",
+        [
+            Metric("device_per_wake_ms", "lower", 0.40),
+            Metric("sweeps_mean", "lower", 0.40),
+        ],
+    ),
+}
+
+
+def _resolve(doc: Any, path: str) -> Optional[float]:
+    """Dotted-path lookup; on a direct miss, descend one level into
+    dict values looking for a sub-document where the full path
+    resolves (the nested round shape)."""
+
+    def direct(node: Any) -> Optional[float]:
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return None
+            node = node[part]
+        if isinstance(node, bool):
+            return float(node)
+        if isinstance(node, (int, float)):
+            return float(node)
+        return None
+
+    value = direct(doc)
+    if value is not None:
+        return value
+    if isinstance(doc, dict):
+        for sub in doc.values():
+            if isinstance(sub, dict):
+                value = direct(sub)
+                if value is not None:
+                    return value
+    return None
+
+
+def trajectory(repo: str, pattern: str) -> List[Tuple[int, str]]:
+    """Sorted (round, path) pairs for one family."""
+    out = []
+    for path in glob.glob(os.path.join(repo, pattern)):
+        match = _ROUND_RE.search(path)
+        if match:
+            out.append((int(match.group(1)), path))
+    return sorted(out)
+
+
+def _load(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def compare_metric(
+    metric: Metric, prior: Optional[float], new: Optional[float]
+) -> Tuple[str, str]:
+    """-> (status, note).  status in PASS/FAIL/SKIP."""
+    if prior is None or new is None:
+        return "SKIP", "metric missing in " + (
+            "both" if prior is None and new is None
+            else ("prior" if prior is None else "newest")
+        )
+    if metric.direction == "higher":
+        floor = prior * (1.0 - metric.tolerance)
+        if new < floor:
+            return "FAIL", f"below floor {floor:.4g}"
+        return "PASS", ""
+    if metric.direction == "lower":
+        ceiling = prior * (1.0 + metric.tolerance)
+        if new > ceiling:
+            return "FAIL", f"above ceiling {ceiling:.4g}"
+        return "PASS", ""
+    # zero: a correctness tally that must never grow
+    if new > prior + metric.tolerance:
+        return "FAIL", f"grew from {prior:g}"
+    return "PASS", ""
+
+
+def check_family(
+    repo: str,
+    family: str,
+    newest_override: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    pattern, metrics = FAMILIES[family]
+    runs = trajectory(repo, pattern)
+    rows: List[Dict[str, Any]] = []
+    if len(runs) < 2 and not (newest_override and runs):
+        rows.append(
+            {
+                "family": family, "metric": "-", "status": "SKIP",
+                "note": f"{len(runs)} committed run(s); need 2",
+            }
+        )
+        return rows
+    if newest_override:
+        prior_round, prior_path = runs[-1]
+        new_round, new_path = prior_round + 1, newest_override
+    else:
+        (prior_round, prior_path), (new_round, new_path) = runs[-2], runs[-1]
+    prior_doc, new_doc = _load(prior_path), _load(new_path)
+    for metric in metrics:
+        prior = _resolve(prior_doc, metric.path) if prior_doc else None
+        new = _resolve(new_doc, metric.path) if new_doc else None
+        status, note = compare_metric(metric, prior, new)
+        delta = ""
+        if prior not in (None, 0) and new is not None:
+            delta = f"{(new - prior) / prior * 100.0:+.1f}%"
+        rows.append(
+            {
+                "family": family,
+                "metric": metric.path,
+                "prior": prior,
+                "new": new,
+                "rounds": f"r{prior_round:02d}->r{new_round:02d}",
+                "delta": delta,
+                "tolerance": metric.tolerance,
+                "direction": metric.direction,
+                "status": status,
+                "note": note,
+            }
+        )
+    return rows
+
+
+def render_table(rows: List[Dict[str, Any]]) -> str:
+    def num(v: Any) -> str:
+        return f"{v:.4g}" if isinstance(v, float) else "-"
+
+    widths = (7, 44, 12, 12, 8, 11, 6)
+    header = ("family", "metric", "prior", "new", "delta", "rounds", "status")
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(header, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for row in rows:
+        cells = (
+            row["family"],
+            row["metric"],
+            num(row.get("prior")),
+            num(row.get("new")),
+            row.get("delta", "") or "-",
+            row.get("rounds", "-"),
+            row["status"],
+        )
+        line = "  ".join(str(c).ljust(w) for c, w in zip(cells, widths))
+        if row.get("note"):
+            line += f"  ({row['note']})"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench-check", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--repo",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding the BENCH_*.json trajectory",
+    )
+    parser.add_argument(
+        "--family",
+        choices=sorted(FAMILIES),
+        action="append",
+        help="check only these families (default: all)",
+    )
+    parser.add_argument(
+        "--check-regression",
+        metavar="FILE",
+        help="treat FILE as the newest run of its family (self-test: a "
+        "doctored copy must FAIL)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="emit the rows as JSON"
+    )
+    args = parser.parse_args(argv)
+
+    families = args.family or sorted(FAMILIES)
+    override_family = None
+    if args.check_regression:
+        base = os.path.basename(args.check_regression)
+        for name, (pattern, _metrics) in FAMILIES.items():
+            if base.startswith(pattern.split("_r")[0]):
+                override_family = name
+        if override_family is None:
+            print(
+                f"bench-check: cannot infer family of {base!r}",
+                file=sys.stderr,
+            )
+            return 2
+        families = [override_family]
+
+    rows: List[Dict[str, Any]] = []
+    for family in families:
+        rows.extend(
+            check_family(
+                args.repo,
+                family,
+                newest_override=(
+                    args.check_regression if family == override_family else None
+                ),
+            )
+        )
+    if args.json:
+        print(json.dumps(rows, indent=2, sort_keys=True))
+    else:
+        print(render_table(rows))
+    failed = [r for r in rows if r["status"] == "FAIL"]
+    if failed:
+        print(
+            f"bench-check: {len(failed)} metric(s) regressed beyond tolerance",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

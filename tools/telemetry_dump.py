@@ -15,6 +15,11 @@ Three sources, one output pipeline (build a metrics registry, render):
 
 Output: ``--format prom`` (default; Prometheus text exposition) or
 ``--format json`` (the registry snapshot).  One document to stdout.
+
+``--series NAME`` switches to the telemetry time plane: render one
+stored series (every labelset fan-out) as an ASCII sparkline + stats,
+from a live ``/timeseries`` endpoint (``--url``) or a JSONL replay
+(``--from-jsonl``) — the renderers are shared with ``tools/uigc_top.py``.
 """
 
 from __future__ import annotations
@@ -165,9 +170,67 @@ def dump_inspect(path, actor, fmt) -> int:
     return 0
 
 
+def dump_series(name, url, jsonl, fmt) -> int:
+    """Render one stored time-plane series (every labelset fan-out) as
+    an ASCII sparkline + stats, from a live ``/timeseries`` endpoint or
+    a JSONL replay — the renderers are tools/uigc_top.py's."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import uigc_top
+
+    if url:
+        try:
+            tsdoc, _alerts, _metrics = uigc_top.fetch_live(
+                url.rstrip("/"), window=1e9
+            )
+        except Exception as exc:
+            print(f"telemetry-dump: {exc}", file=sys.stderr)
+            return 1
+    else:
+        try:
+            tsdoc, _alerts, _metrics = uigc_top.replay_model(jsonl)
+        except (FileNotFoundError, OSError) as exc:
+            print(f"telemetry-dump: {exc}", file=sys.stderr)
+            return 1
+    matching = [s for s in tsdoc.get("series", []) if s.get("name") == name]
+    if not matching:
+        known = sorted({s.get("name") for s in tsdoc.get("series", [])})
+        print(
+            f"telemetry-dump: no series {name!r}; known: {', '.join(known)}",
+            file=sys.stderr,
+        )
+        return 1
+    if fmt == "json":
+        print(json.dumps(
+            {"name": name, "series": matching},
+            indent=2, sort_keys=True, default=repr,
+        ))
+        return 0
+    mode = "rate" if name.endswith("_total") else "mean"
+    print(f"{name}  ({len(matching)} labelset(s), mode={mode})")
+    for series in matching:
+        labels = series.get("labels") or {}
+        label = (
+            ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "(all)"
+        )
+        points = uigc_top.series_points(series, mode)
+        print("  " + uigc_top.render_series(label[:16], points, width=48))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="telemetry-dump", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "--series",
+        metavar="NAME",
+        help="render one time-plane series (sparkline + stats) from "
+        "--url or --from-jsonl (tools/uigc_top.py renderers)",
+    )
+    parser.add_argument(
+        "--url",
+        metavar="URL",
+        help="live metrics-HTTP base URL for --series",
     )
     source = parser.add_mutually_exclusive_group()
     source.add_argument("--from-jsonl", metavar="PATH", help="replay a JSONL event log")
@@ -197,6 +260,10 @@ def main(argv=None) -> int:
         help="output format (default: prom)",
     )
     args = parser.parse_args(argv)
+    if args.series:
+        if not args.url and not args.from_jsonl:
+            parser.error("--series needs --url or --from-jsonl")
+        return dump_series(args.series, args.url, args.from_jsonl, args.format)
     if args.inspect is not None:
         return dump_inspect(args.inspect, args.actor, args.format)
     if args.from_jsonl:

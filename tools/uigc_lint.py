@@ -71,6 +71,17 @@ UL007  socket-io-under-peer-lock
     on the per-peer writer thread, off-lock.  Grandfathered nowhere —
     new occurrences always fail ``--strict``.
 
+UL009  metric-name-convention
+    A metric registered at a ``registry.counter/gauge/histogram(...)``
+    call site (any receiver, first argument a string literal) whose
+    name does not carry the ``uigc_`` prefix, or — for counters and
+    histograms — no unit suffix (``_seconds``/``_bytes``/``_total``/
+    ``_ratio``).  Gauges are exempt from the unit suffix (a gauge's
+    unit is its referent: actors, frames, phi), but not the prefix.
+    Unprefixed names collide in shared Prometheus scrapes; unitless
+    names make dashboards guess.  Registrations built from a non-literal
+    first argument are not linted (nothing to check statically).
+
 UL008  inspector-mutates-engine-state
     Snapshot/inspect code (``uigc_tpu/telemetry/inspect.py``) broke its
     read-only contract.  The liveness inspector observes the collector's
@@ -120,7 +131,12 @@ RULES = {
     "UL006": "direct ProxyCell construction outside runtime/",
     "UL007": "blocking socket call while holding a _PeerState lock",
     "UL008": "snapshot/inspect code mutates engine state",
+    "UL009": "metric name violates the uigc_ prefix / unit-suffix convention",
 }
+
+#: UL009: unit suffixes a counter or histogram name must end with.
+_METRIC_UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio")
+_METRIC_REGISTRARS = {"counter", "gauge", "histogram"}
 
 #: engine/collector mutators the read-only inspector must never call
 #: (UL008).  Local containers (dict.pop, list.append, deque, events
@@ -269,8 +285,10 @@ class _FileLinter:
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
-            elif isinstance(node, ast.Call) and not in_runtime:
-                self._lint_proxycell(node)
+            elif isinstance(node, ast.Call):
+                if not in_runtime:
+                    self._lint_proxycell(node)
+                self._lint_metric_name(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
         if self.path.replace(os.sep, "/").endswith("telemetry/inspect.py"):
@@ -423,6 +441,36 @@ class _FileLinter:
                     walk(child, held)
 
         walk(fn, False)
+
+    def _lint_metric_name(self, call: ast.Call) -> None:
+        """UL009: metric names registered via ``.counter/.gauge/
+        .histogram(...)`` must carry the ``uigc_`` prefix; counters and
+        histograms also need a unit suffix."""
+        fn = call.func
+        if not isinstance(fn, ast.Attribute) or fn.attr not in _METRIC_REGISTRARS:
+            return
+        if not call.args:
+            return
+        first = call.args[0]
+        if not isinstance(first, ast.Constant) or not isinstance(
+            first.value, str
+        ):
+            return  # dynamic name: nothing to check statically
+        name = first.value
+        if not name.startswith("uigc_"):
+            self.add(
+                call.lineno,
+                "UL009",
+                f"metric {name!r} lacks the uigc_ prefix",
+            )
+            return
+        if fn.attr != "gauge" and not name.endswith(_METRIC_UNIT_SUFFIXES):
+            self.add(
+                call.lineno,
+                "UL009",
+                f"{fn.attr} {name!r} lacks a unit suffix "
+                f"({'/'.join(_METRIC_UNIT_SUFFIXES)})",
+            )
 
     def _lint_proxycell(self, call: ast.Call) -> None:
         """UL006: ProxyCell must come from the fabric's cache (or, for

@@ -238,6 +238,41 @@ DEFAULTS: Dict[str, Any] = {
     # on NodeFabric crash injection and on telemetry close, the ring +
     # a final snapshot are written here as one JSON document.
     "uigc.telemetry.inspect-dump-path": "",
+    # --- Telemetry time plane (uigc_tpu/telemetry/timeseries.py) ---
+    # Attach the per-node time-series store + sampler thread: metric
+    # history in multi-resolution ring buffers, the /timeseries HTTP
+    # route, tsq/tsr cluster aggregation on a NodeFabric, and (with
+    # uigc.telemetry.alerts) the anomaly/SLO engine.  Implies the
+    # metrics registry.
+    "uigc.telemetry.timeseries": False,
+    # Milliseconds between sampler ticks (each tick snapshots the
+    # registry into the store and evaluates alert rules).
+    "uigc.telemetry.ts-sample-interval": 1000,
+    # Downsampling tiers as "res_sxcount" pairs: the default keeps 120s
+    # of 1s buckets, 30min of 10s buckets and 4h of 1min buckets per
+    # series — O(1) memory per series regardless of sample count.
+    "uigc.telemetry.ts-tiers": "1x120,10x180,60x240",
+    # Per-metric labelset bound, shared by the metrics registry and the
+    # time-series store: past it, new labelsets fold into one
+    # overflow="true" labelset and a telemetry.labelset_overflow event
+    # fires once per metric — dynamic labels (per-peer, per-shard)
+    # can no longer grow a metric's memory without bound.
+    "uigc.telemetry.max-labelsets": 512,
+    # Evaluate the built-in anomaly/SLO rules (wake-latency regression,
+    # frame-gap/dup spikes, writer-queue saturation, leak-suspect
+    # growth, heartbeat-phi climb) on the sampler cadence; firing rules
+    # emit telemetry.alert events, count into
+    # uigc_alerts_total{rule,severity} and serve on /alerts.  Only
+    # meaningful with uigc.telemetry.timeseries on.
+    "uigc.telemetry.alerts": True,
+    # EWMA-sigma deviation at which a regression rule fires.
+    "uigc.telemetry.alert-ewma-sigma": 3.0,
+    # Absolute wake-latency floor (seconds) that fires the wake rule
+    # regardless of the learned baseline; 0 = EWMA-only.
+    "uigc.telemetry.alert-wake-threshold": 0.0,
+    # Frame gap/duplicate rate (frames/s over the rule window) above
+    # which the spike rules fire.
+    "uigc.telemetry.alert-gap-rate": 1.0,
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,

@@ -20,6 +20,7 @@ protocol's intent — counts travel in entries, never inside refs
 from __future__ import annotations
 
 import io
+import json
 import pickle
 import struct
 from collections import deque
@@ -507,6 +508,67 @@ def decode_snap_frame(frame: tuple):
                 return None
             return "rsp", int(frame[2]), str(frame[3]), payload
         return None
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+# ------------------------------------------------------------------- #
+# Telemetry time-plane frames (uigc_tpu/telemetry/timeseries.py)
+#
+# A query/response pair for coordinator-free cluster aggregation of the
+# per-node time-series stores: any node fans a ``tsq`` out to its peers
+# and folds the ``tsr`` responses, degrading to ``missing_nodes`` for
+# peers that never answer — the same tolerance contract as the ``snap``
+# frames above (trailing elements accepted, malformed -> None, unknown
+# kinds ignored by old peers after seq accounting).
+#
+#   ("tsq", req_id, origin, query_json)     pull a peer's series
+#   ("tsr", req_id, origin, payload_json)   the series document
+#
+# Both payloads are UTF-8 JSON bytes — data, never pickle, so a
+# malformed or malicious peer document can at worst fail json.loads.
+# Unknown query keys are ignored by the responder (a newer peer may ask
+# for filters an older one does not know).
+# ------------------------------------------------------------------- #
+
+TSQ_FRAME_KIND = "tsq"
+TSR_FRAME_KIND = "tsr"
+
+
+def encode_ts_query(req_id: int, origin: str, query: dict) -> tuple:
+    return ("tsq", int(req_id), origin, json.dumps(query, default=repr).encode())
+
+
+def decode_ts_query(frame: tuple):
+    """-> (req_id, origin, query_dict) or None.  An unreadable query
+    body degrades to ``{}`` (answer with everything) rather than
+    dropping the frame — version tolerance over strictness."""
+    try:
+        req_id, origin, payload = frame[1], frame[2], frame[3]
+        if not isinstance(payload, bytes):
+            return None
+        try:
+            query = json.loads(payload)
+        except ValueError:
+            query = {}
+        if not isinstance(query, dict):
+            query = {}
+        return int(req_id), str(origin), query
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_ts_response(req_id: int, origin: str, payload: bytes) -> tuple:
+    return ("tsr", int(req_id), origin, payload)
+
+
+def decode_ts_response(frame: tuple):
+    """-> (req_id, origin, payload_bytes) or None."""
+    try:
+        req_id, origin, payload = frame[1], frame[2], frame[3]
+        if not isinstance(payload, bytes):
+            return None
+        return int(req_id), str(origin), payload
     except (IndexError, TypeError, ValueError):
         return None
 

@@ -20,7 +20,16 @@ attributed to a single GC wave.  This package is the subsystem on top
 - :mod:`uigc_tpu.telemetry.inspect` — the liveness inspector: why-live
   retaining paths from the marking-parent forest, flight-recorder
   snapshots with retained-set diffing, the leak watchdog, and the
-  cross-node merged graph (read-only by the UL008 contract).
+  cross-node merged graph (read-only by the UL008 contract);
+- :mod:`uigc_tpu.telemetry.timeseries` — the time plane: per-node
+  multi-resolution metric history (ring buffers, O(1) memory), a
+  sampler thread feeding it from the registry/wake profiler/send
+  matrix, and coordinator-free cluster aggregation over the
+  ``tsq``/``tsr`` fabric frames;
+- :mod:`uigc_tpu.telemetry.alerts` — declarative anomaly/SLO rules
+  (threshold, rate-of-change, EWMA-sigma) evaluated against the store,
+  emitting ``telemetry.alert`` events and
+  ``uigc_alerts_total{rule,severity}``.
 
 Everything is off by default and attached per-system from the
 ``uigc.telemetry.*`` config keys; :class:`Telemetry` is the composition
@@ -39,9 +48,11 @@ from .exporter import (
     replay_jsonl,
     replay_violations,
 )
+from .alerts import AlertEngine, AlertRule, builtin_rules
 from .inspect import FlightRecorder, LeakWatchdog, LivenessInspector
 from .metrics import EventMetricsBridge, MetricsRegistry, install_system_gauges
 from .profile import WakeProfiler
+from .timeseries import MetricsSampler, TimeSeriesStore, merge_series_docs, parse_tiers
 from .tracing import Tracer, chrome_trace, write_chrome_trace
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -56,6 +67,13 @@ __all__ = [
     "LivenessInspector",
     "FlightRecorder",
     "LeakWatchdog",
+    "TimeSeriesStore",
+    "MetricsSampler",
+    "AlertEngine",
+    "AlertRule",
+    "builtin_rules",
+    "merge_series_docs",
+    "parse_tiers",
     "MetricsHTTPServer",
     "JsonlEventSink",
     "prometheus_text",
@@ -82,19 +100,32 @@ class Telemetry:
         )
         self.profiler: Optional[WakeProfiler] = None
         self.inspector: Optional[LivenessInspector] = None
+        self.store: Optional[TimeSeriesStore] = None
+        self.sampler: Optional[MetricsSampler] = None
+        self.alerts: Optional[AlertEngine] = None
         self.http: Optional[MetricsHTTPServer] = None
         self.jsonl: Optional[JsonlEventSink] = None
         self._listeners: List[Any] = []
         self._snap_frame_registered = False
+        self._ts_frames_registered = False
 
-        metrics_on = config.get_bool("uigc.telemetry.metrics")
-        profile_on = config.get_bool("uigc.telemetry.wake-profile")
+        timeseries_on = config.get_bool("uigc.telemetry.timeseries")
+        # The time plane samples the registry, so it implies metrics.
+        metrics_on = config.get_bool("uigc.telemetry.metrics") or timeseries_on
+        profile_on = (
+            config.get_bool("uigc.telemetry.wake-profile")
+            # ... and feeds wake latency from the profiler's records.
+            or timeseries_on
+        )
         inspect_on = config.get_bool("uigc.telemetry.inspect")
         http_port = config.get_int("uigc.telemetry.http-port")
         jsonl_path = config.get_string("uigc.telemetry.jsonl-path")
 
         if metrics_on or http_port >= 0:
-            self.registry = MetricsRegistry(const_labels={"node": system.address})
+            self.registry = MetricsRegistry(
+                const_labels={"node": system.address},
+                max_labelsets=config.get_int("uigc.telemetry.max-labelsets"),
+            )
             install_system_gauges(self.registry, system)
         if metrics_on:
             bridge = EventMetricsBridge(self.registry, node=system.address)
@@ -110,6 +141,8 @@ class Telemetry:
                 engine.wake_profiler = self.profiler
         if inspect_on:
             self.inspector = self._attach_inspector()
+        if timeseries_on:
+            self._attach_timeseries()
         if jsonl_path:
             self.jsonl = JsonlEventSink(
                 jsonl_path,
@@ -123,6 +156,8 @@ class Telemetry:
                 port=http_port,
                 inspector=self.inspector,
                 node=system.address,
+                store=self.store,
+                alerts=self.alerts,
             )
 
         if self._listeners or self.inspector is not None:
@@ -201,6 +236,78 @@ class Telemetry:
             )
         return inspector
 
+    def _attach_timeseries(self) -> None:
+        """Wire the time plane: store + sampler thread, the anomaly/SLO
+        engine, send-matrix capture enablement (a mutation, so it lives
+        HERE, not in the read-path modules), and — on a NodeFabric —
+        the ``tsq``/``tsr`` frame pair behind coordinator-free cluster
+        aggregation."""
+        system = self.system
+        config = system.config
+        self.store = TimeSeriesStore(
+            node=system.address,
+            tiers=parse_tiers(config.get_string("uigc.telemetry.ts-tiers")),
+            max_labelsets=config.get_int("uigc.telemetry.max-labelsets"),
+        )
+        if config.get_bool("uigc.telemetry.alerts"):
+            self.alerts = AlertEngine(self.store, node=system.address)
+            self.alerts.add_rules(builtin_rules(config))
+        # Send-matrix accumulation: the drift series item 5's
+        # partitioner will consume (the inspector enables the same dict
+        # when it attaches; either one suffices).
+        engine = getattr(system, "engine", None)
+        bookkeeper = getattr(engine, "bookkeeper", None)
+        graph_fn = None
+        if bookkeeper is not None:
+            graph = bookkeeper.shadow_graph
+            if hasattr(graph, "send_matrix") and graph.send_matrix is None:
+                graph.send_matrix = {}
+            graph_fn = lambda: bookkeeper.shadow_graph  # noqa: E731
+        self.sampler = MetricsSampler(
+            self.store,
+            registry=self.registry,
+            profiler=self.profiler,
+            graph_fn=graph_fn,
+            alerts=self.alerts,
+            interval_s=config.get_int("uigc.telemetry.ts-sample-interval")
+            / 1000.0,
+        ).start()
+        # Cluster pull: register the tsq/tsr frames on fabrics that
+        # speak custom frame kinds (NodeFabric).  Dead peers stay in
+        # the known set so a merge names them in missing_nodes instead
+        # of silently forgetting them.
+        fabric = getattr(system, "fabric", None)
+        if fabric is not None and hasattr(fabric, "register_frame_handler"):
+            from ..runtime import wire
+
+            store = self.store
+
+            def _tsq_handler(from_address: str, frame: tuple) -> None:
+                decoded = wire.decode_ts_query(frame)
+                if decoded is not None:
+                    store.on_query_frame(from_address, *decoded)
+
+            def _tsr_handler(from_address: str, frame: tuple) -> None:
+                decoded = wire.decode_ts_response(frame)
+                if decoded is not None:
+                    store.on_response_frame(*decoded)
+
+            fabric.register_frame_handler(wire.TSQ_FRAME_KIND, _tsq_handler)
+            fabric.register_frame_handler(wire.TSR_FRAME_KIND, _tsr_handler)
+            self._ts_frames_registered = True
+            store.bind_fabric(
+                known_peers_fn=lambda: [
+                    a for a in list(fabric._conns) if a != system.address
+                ],
+                live_peers_fn=fabric._live_peers,
+                send_query=lambda addr, rid, q: fabric.send_frame(
+                    addr, wire.encode_ts_query(rid, system.address, q)
+                ),
+                send_response=lambda addr, rid, payload: fabric.send_frame(
+                    addr, wire.encode_ts_response(rid, system.address, payload)
+                ),
+            )
+
     # ------------------------------------------------------------- #
 
     @classmethod
@@ -216,6 +323,19 @@ class Telemetry:
         for listener in self._listeners:
             events.recorder.remove_listener(listener)
         self._listeners = []
+        if self.sampler is not None:
+            self.sampler.close()
+            self.sampler = None
+        if self._ts_frames_registered:
+            fabric = getattr(self.system, "fabric", None)
+            if fabric is not None:
+                from ..runtime import wire
+
+                fabric.register_frame_handler(wire.TSQ_FRAME_KIND, None)
+                fabric.register_frame_handler(wire.TSR_FRAME_KIND, None)
+            self._ts_frames_registered = False
+        self.store = None
+        self.alerts = None
         engine = getattr(self.system, "engine", None)
         if engine is not None and engine.wake_profiler is self.profiler:
             engine.wake_profiler = None

@@ -87,16 +87,23 @@ class MetricsHTTPServer:
     ``/healthz`` on a loopback port from a daemon thread; with a
     liveness inspector attached (``uigc.telemetry.inspect``), also
     ``/snapshot`` (``?merged=1`` for the cluster-wide graph) and
-    ``/inspect?actor=<path-or-key>`` (a why-live retaining path).
+    ``/inspect?actor=<path-or-key>`` (a why-live retaining path); with
+    the time plane attached (``uigc.telemetry.timeseries``), also
+    ``/timeseries`` (``?name=``/``?window=``/``?resolution=`` select a
+    series and range, ``?merged=1`` pulls and merges the cluster's
+    stores over the ``tsq``/``tsr`` frames) and ``/alerts`` (the
+    anomaly/SLO engine's firing set and rule catalog).
     ``port=0`` binds an ephemeral port; read the bound one from
     :attr:`port`."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  host: str = "127.0.0.1", inspector: Any = None,
-                 node: str = ""):
+                 node: str = "", store: Any = None, alerts: Any = None):
         self.registry = registry
         self.inspector = inspector
         self.node = node
+        self.store = store
+        self.alerts = alerts
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -114,6 +121,22 @@ class MetricsHTTPServer:
                     body = json.dumps(
                         {"status": "ok", "node": outer.node, "t": time.time()}
                     )
+                    ctype = "application/json"
+                elif route.startswith("/timeseries") and outer.store is not None:
+                    try:
+                        body = json.dumps(
+                            outer._timeseries_doc(query), default=repr
+                        )
+                    except Exception as exc:
+                        self._send_json_error(500, repr(exc))
+                        return
+                    ctype = "application/json"
+                elif route.startswith("/alerts") and outer.alerts is not None:
+                    try:
+                        body = json.dumps(outer.alerts.to_doc(), default=repr)
+                    except Exception as exc:
+                        self._send_json_error(500, repr(exc))
+                        return
                     ctype = "application/json"
                 elif route.startswith("/snapshot") and outer.inspector is not None:
                     try:
@@ -175,6 +198,34 @@ class MetricsHTTPServer:
             daemon=True,
         )
         self._thread.start()
+
+    def _timeseries_doc(self, query: Dict[str, List[str]]) -> Dict[str, Any]:
+        """The ``/timeseries`` body for one parsed query string."""
+
+        def first(key: str, default: str = "") -> str:
+            return query.get(key, [default])[0]
+
+        name = first("name") or None
+        window = float(first("window") or 0) or None
+        merged = first("merged") in ("1", "true", "yes")
+        if merged:
+            q: Dict[str, Any] = {}
+            if name:
+                q["name"] = name
+            if window:
+                q["window"] = window
+            return self.store.merged(q)
+        if name is not None and first("labels_json"):
+            # One exact series with its bucket dicts (the stable
+            # range() shape); labels ride as a JSON object.
+            labels = json.loads(first("labels_json"))
+            return self.store.range(
+                name,
+                labels=labels,
+                window_s=window or 120.0,
+                resolution=float(first("resolution") or 0) or None,
+            )
+        return self.store.to_doc(name=name, window_s=window)
 
     def close(self) -> None:
         self._server.shutdown()

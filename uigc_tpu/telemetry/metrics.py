@@ -39,6 +39,13 @@ COUNT_BUCKETS: Tuple[float, ...] = tuple(float(2**i) for i in range(17))
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
+#: Default per-metric labelset bound (``uigc.telemetry.max-labelsets``).
+#: Dynamic labels (per-peer, per-shard, per-source) would otherwise grow
+#: every ``_values``/``_data`` dict without bound for the life of the
+#: process; past the bound, new labelsets fold into this one.
+DEFAULT_MAX_LABELSETS = 512
+OVERFLOW_LABELS: LabelKey = (("overflow", "true"),)
+
 
 def _label_key(labels: Dict[str, Any]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
@@ -49,10 +56,43 @@ class _Metric:
 
     kind = "untyped"
 
-    def __init__(self, name: str, help_text: str, lock: threading.Lock):
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        lock: threading.Lock,
+        max_labelsets: int = DEFAULT_MAX_LABELSETS,
+    ):
         self.name = name
         self.help_text = help_text
         self._lock = lock
+        self._max_labelsets = max(1, int(max_labelsets))
+        self._overflowed = False
+
+    def _bound_key_locked(self, key: LabelKey, store: Dict[LabelKey, Any]) -> LabelKey:
+        """Cardinality bound (caller holds the metric lock): a NEW
+        labelset past the cap folds into ``overflow="true"`` so memory
+        stays bounded and the aggregate stays observable.  Returns the
+        (possibly folded) key; the first fold arms the one-shot
+        ``telemetry.labelset_overflow`` event, emitted by the caller
+        OUTSIDE the lock."""
+        if key in store or len(store) < self._max_labelsets:
+            return key
+        return OVERFLOW_LABELS
+
+    def _note_overflow_locked(self) -> bool:
+        if self._overflowed:
+            return False
+        self._overflowed = True
+        return True
+
+    def _emit_overflow(self) -> None:
+        events.recorder.commit(
+            events.LABELSET_OVERFLOW,
+            scope="registry",
+            metric=self.name,
+            limit=self._max_labelsets,
+        )
 
     def samples(self) -> List[Tuple[str, LabelKey, float]]:
         """Flat (suffix, labels, value) samples for the exporter."""
@@ -64,8 +104,14 @@ class Counter(_Metric):
 
     kind = "counter"
 
-    def __init__(self, name: str, help_text: str, lock: threading.Lock):
-        super().__init__(name, help_text, lock)
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        lock: threading.Lock,
+        max_labelsets: int = DEFAULT_MAX_LABELSETS,
+    ):
+        super().__init__(name, help_text, lock, max_labelsets)
         self._values: Dict[LabelKey, float] = {}
 
     def inc(self, amount: float = 1.0, **labels: Any) -> None:
@@ -77,8 +123,14 @@ class Counter(_Metric):
             amount=amount,
         )
         key = _label_key(labels)
+        overflowed = False
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            bounded = self._bound_key_locked(key, self._values)
+            if bounded is not key:
+                overflowed = self._note_overflow_locked()
+            self._values[bounded] = self._values.get(bounded, 0.0) + amount
+        if overflowed:
+            self._emit_overflow()
 
     def value(self, **labels: Any) -> float:
         with self._lock:
@@ -107,15 +159,23 @@ class Gauge(_Metric):
         lock: threading.Lock,
         fn: Optional[Callable[[], Any]] = None,
         label_name: str = "key",
+        max_labelsets: int = DEFAULT_MAX_LABELSETS,
     ):
-        super().__init__(name, help_text, lock)
+        super().__init__(name, help_text, lock, max_labelsets)
         self._values: Dict[LabelKey, float] = {}
         self._fn = fn
         self._label_name = label_name
 
     def set(self, value: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        overflowed = False
         with self._lock:
-            self._values[_label_key(labels)] = float(value)
+            bounded = self._bound_key_locked(key, self._values)
+            if bounded is not key:
+                overflowed = self._note_overflow_locked()
+            self._values[bounded] = float(value)
+        if overflowed:
+            self._emit_overflow()
 
     def value(self, **labels: Any) -> float:
         with self._lock:
@@ -158,8 +218,9 @@ class Histogram(_Metric):
         help_text: str,
         lock: threading.Lock,
         buckets: Tuple[float, ...] = DURATION_BUCKETS,
+        max_labelsets: int = DEFAULT_MAX_LABELSETS,
     ):
-        super().__init__(name, help_text, lock)
+        super().__init__(name, help_text, lock, max_labelsets)
         require(
             len(buckets) > 0 and list(buckets) == sorted(buckets),
             "metrics.bad_buckets",
@@ -177,8 +238,14 @@ class Histogram(_Metric):
 
     def observe(self, value: float, **labels: Any) -> None:
         key = _label_key(labels)
+        overflowed = False
         with self._lock:
-            self._slot(key).observe(float(value))
+            bounded = self._bound_key_locked(key, self._data)
+            if bounded is not key:
+                overflowed = self._note_overflow_locked()
+            self._slot(bounded).observe(float(value))
+        if overflowed:
+            self._emit_overflow()
 
     def snapshot(self, **labels: Any) -> Dict[str, Any]:
         with self._lock:
@@ -221,10 +288,15 @@ class MetricsRegistry:
     """A named collection of metrics with optional constant labels
     (e.g. ``node=<address>``) applied to every sample at export."""
 
-    def __init__(self, const_labels: Optional[Dict[str, Any]] = None):
+    def __init__(
+        self,
+        const_labels: Optional[Dict[str, Any]] = None,
+        max_labelsets: int = DEFAULT_MAX_LABELSETS,
+    ):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self.const_labels = _label_key(const_labels or {})
+        self.max_labelsets = max(1, int(max_labelsets))
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -243,7 +315,9 @@ class MetricsRegistry:
             return metric
 
     def counter(self, name: str, help_text: str = "") -> Counter:
-        return self._register(Counter(name, help_text, threading.Lock()))  # type: ignore[return-value]
+        return self._register(  # type: ignore[return-value]
+            Counter(name, help_text, threading.Lock(), self.max_labelsets)
+        )
 
     def gauge(
         self,
@@ -252,7 +326,12 @@ class MetricsRegistry:
         fn: Optional[Callable[[], Any]] = None,
         label_name: str = "key",
     ) -> Gauge:
-        return self._register(Gauge(name, help_text, threading.Lock(), fn, label_name))  # type: ignore[return-value]
+        return self._register(  # type: ignore[return-value]
+            Gauge(
+                name, help_text, threading.Lock(), fn, label_name,
+                self.max_labelsets,
+            )
+        )
 
     def histogram(
         self,
@@ -260,7 +339,11 @@ class MetricsRegistry:
         help_text: str = "",
         buckets: Tuple[float, ...] = DURATION_BUCKETS,
     ) -> Histogram:
-        return self._register(Histogram(name, help_text, threading.Lock(), buckets))  # type: ignore[return-value]
+        return self._register(  # type: ignore[return-value]
+            Histogram(
+                name, help_text, threading.Lock(), buckets, self.max_labelsets
+            )
+        )
 
     def metrics(self) -> List[_Metric]:
         with self._lock:
@@ -308,7 +391,7 @@ class EventMetricsBridge:
             "uigc_gc_wave_seconds", "Latency of one collection (trace + sweep)."
         )
         self._wave_garbage = r.histogram(
-            "uigc_gc_garbage_actors",
+            "uigc_gc_wave_garbage_total",
             "Garbage actors found per collection wave.",
             buckets=COUNT_BUCKETS,
         )
@@ -346,7 +429,7 @@ class EventMetricsBridge:
             "uigc_frames_corrupt_total", "Frames whose body failed to decode."
         )
         self._batch_size = r.histogram(
-            "uigc_frame_batch_size",
+            "uigc_frame_batch_frames_total",
             "Frames coalesced per peer-writer flush (runtime/node.py).",
             buckets=COUNT_BUCKETS,
         )
@@ -410,6 +493,16 @@ class EventMetricsBridge:
         self._inspect_snapshots = r.counter(
             "uigc_inspect_snapshots_total",
             "Flight-recorder shadow-graph snapshots captured.",
+        )
+        self._alerts = r.counter(
+            "uigc_alerts_total",
+            "Anomaly/SLO alerts fired, by rule and severity "
+            "(uigc_tpu/telemetry/alerts.py).",
+        )
+        self._labelset_overflows = r.counter(
+            "uigc_labelset_overflows_total",
+            "Metrics whose labelset count crossed the cardinality bound "
+            "(uigc.telemetry.max-labelsets).",
         )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
@@ -494,6 +587,17 @@ class EventMetricsBridge:
             self._leak_suspects.inc()
         elif name == events.SNAPSHOT:
             self._inspect_snapshots.inc()
+        elif name == events.ALERT:
+            # Firing transitions only: resolve events change state but
+            # are not new alerts.  Counted here (not by the engine) so
+            # offline JSONL replay rebuilds identical totals.
+            if fields.get("state", "firing") == "firing":
+                self._alerts.inc(
+                    rule=fields.get("rule", "?"),
+                    severity=fields.get("severity", "?"),
+                )
+        elif name == events.LABELSET_OVERFLOW:
+            self._labelset_overflows.inc(scope=fields.get("scope", "?"))
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:

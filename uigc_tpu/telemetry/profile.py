@@ -210,6 +210,16 @@ class WakeProfiler:
         else:
             wake.sweep_s += duration
 
+    # -- reading ----------------------------------------------------- #
+
+    def wakes_since(self, t0: float) -> List[Dict[str, Any]]:
+        """Recent wake records newer than ``t0`` (their ``t`` stamp),
+        oldest first — the time-plane sampler's feed
+        (uigc_tpu/telemetry/timeseries.py): each call hands over only
+        the wakes completed since the last tick."""
+        with self._lock:
+            return [dict(r) for r in self._recent if r["t"] > t0]
+
     # -- export ------------------------------------------------------ #
 
     def to_json(self) -> Dict[str, Any]:

@@ -103,7 +103,7 @@ FRAME_CORRUPT = "fabric.frame_corrupt"
 LOOKUP_MISS = "fabric.lookup_miss"
 #: one per-peer writer flush coalesced into a multi-frame batch unit
 #: (fields: dst, size=frames in the batch, bytes=wire bytes) — feeds the
-#: ``uigc_frame_batch_size`` histogram.
+#: ``uigc_frame_batch_frames_total`` histogram.
 FRAME_BATCH = "fabric.frame_batch"
 #: a frame that had already claimed its sequence number could not reach
 #: the peer (link broke mid-flush, or died while frames were queued);
@@ -154,9 +154,21 @@ SHARD_STATE_CONFLICT = "shard.state_conflict"
 #   telemetry.snapshot        the flight recorder captured a shadow-graph
 #                             snapshot (fields: node, wave, reason,
 #                             actors, edges).
+#   telemetry.alert           an anomaly/SLO rule changed state (fields:
+#                             rule, severity, series, labels, value,
+#                             threshold, node, state="firing"|"resolved");
+#                             firing transitions count into
+#                             uigc_alerts_total{rule,severity}.
+#   telemetry.labelset_overflow  a metric crossed the per-metric labelset
+#                             bound (uigc.telemetry.max-labelsets) and
+#                             new labelsets folded into the
+#                             overflow="true" labelset; emitted once per
+#                             metric (fields: scope, metric, limit).
 LISTENER_ERROR = "telemetry.listener_error"
 LEAK_SUSPECT = "telemetry.leak_suspect"
 SNAPSHOT = "telemetry.snapshot"
+ALERT = "telemetry.alert"
+LABELSET_OVERFLOW = "telemetry.labelset_overflow"
 
 #: Per-thread event origin (a node address).  The recorder is a process
 #: singleton; when several ActorSystems share one process (the
