@@ -56,6 +56,10 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
         "BENCH_FABRIC_r*.json",
         [
             Metric("link.batch.frames_per_sec", "higher", 0.40),
+            # r02+: the co-located shm + schema-codec path (the 250k/s
+            # acceptance floor and the 500k ROADMAP target live here).
+            # SKIPs against rounds that predate the mode.
+            Metric("link.shm.frames_per_sec", "higher", 0.40),
             Metric("teardown.actors_per_sec", "higher", 0.40),
         ],
     ),

@@ -71,6 +71,18 @@ UL007  socket-io-under-peer-lock
     on the per-peer writer thread, off-lock.  Grandfathered nowhere —
     new occurrences always fail ``--strict``.
 
+UL010  pickle-on-runtime-hot-path
+    A direct ``pickle.dumps``/``loads``/``dump``/``load``/``Pickler``/
+    ``Unpickler`` call in a ``runtime/`` module other than ``wire.py``
+    (the sanctioned codec module, whose pickle use IS the negotiated
+    fallback).  PR 9 made pickle the fallback, not the default: known
+    message shapes cross links schema-native (runtime/schema.py), and
+    a stray pickle call on a hot-path module silently reintroduces the
+    per-message protocol dispatch the codec removed — or worse, emits
+    bytes a peer's negotiated decoder will not recognize.  Encode
+    through ``wire.encode_message_schema``/``wire.encode_message``;
+    legacy transport framing sites are grandfathered in the allowlist.
+
 UL009  metric-name-convention
     A metric registered at a ``registry.counter/gauge/histogram(...)``
     call site (any receiver, first argument a string literal) whose
@@ -132,7 +144,11 @@ RULES = {
     "UL007": "blocking socket call while holding a _PeerState lock",
     "UL008": "snapshot/inspect code mutates engine state",
     "UL009": "metric name violates the uigc_ prefix / unit-suffix convention",
+    "UL010": "direct pickle call on a runtime hot-path module outside wire.py",
 }
+
+#: UL010: the pickle entry points that bypass the schema codec.
+_PICKLE_CALLS = {"dumps", "loads", "dump", "load", "Pickler", "Unpickler"}
 
 #: UL009: unit suffixes a counter or histogram name must end with.
 _METRIC_UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio")
@@ -282,12 +298,16 @@ class _FileLinter:
 
     def run(self, lint_asserts: bool) -> None:
         in_runtime = "runtime" in self.path.split(os.sep)
+        norm = self.path.replace(os.sep, "/")
+        pickle_guarded = in_runtime and not norm.endswith("runtime/wire.py")
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
             elif isinstance(node, ast.Call):
                 if not in_runtime:
                     self._lint_proxycell(node)
+                if pickle_guarded:
+                    self._lint_pickle_hot_path(node)
                 self._lint_metric_name(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
@@ -470,6 +490,22 @@ class _FileLinter:
                 "UL009",
                 f"{fn.attr} {name!r} lacks a unit suffix "
                 f"({'/'.join(_METRIC_UNIT_SUFFIXES)})",
+            )
+
+    def _lint_pickle_hot_path(self, call: ast.Call) -> None:
+        """UL010: pickle stays behind the wire.py fallback on runtime
+        hot-path modules — a stray direct call reintroduces per-message
+        protocol dispatch (or un-negotiated bytes) the schema codec
+        removed."""
+        qual, name = _call_name(call)
+        if qual == "pickle" and name in _PICKLE_CALLS:
+            self.add(
+                call.lineno,
+                "UL010",
+                f"direct pickle.{name}() on a runtime hot-path module; "
+                "route through wire.encode_message_schema / "
+                "wire.decode_message (pickle is the sanctioned fallback "
+                "inside runtime/wire.py only)",
             )
 
     def _lint_proxycell(self, call: ast.Call) -> None:

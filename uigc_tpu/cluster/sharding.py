@@ -541,6 +541,10 @@ class ClusterSharding:
         self.retry_s = config.get_int("uigc.cluster.handoff-retry") / 1000.0
         self.max_hops = config.get_int("uigc.cluster.max-forward-hops")
         self.hold_timeout_s = config.get_int("uigc.cluster.hold-timeout") / 1000.0
+        #: key -> shard memo: the blake2b in shard_of was a measurable
+        #: slice of every routed message.  GIL-atomic dict ops, bounded
+        #: by wholesale clear (hot keys re-warm in one burst).
+        self._shard_cache: Dict[str, int] = {}
 
         self._lock = threading.RLock()
         self._regions: Dict[str, ShardRegion] = {}
@@ -649,8 +653,17 @@ class ClusterSharding:
 
     # -- placement --------------------------------------------------- #
 
+    def shard_of_key(self, key: str) -> int:
+        """Memoized :func:`shard_of` (routing hot path)."""
+        shard = self._shard_cache.get(key)
+        if shard is None:
+            if len(self._shard_cache) >= 65536:
+                self._shard_cache.clear()
+            shard = self._shard_cache[key] = shard_of(key, self.num_shards)
+        return shard
+
     def home_of(self, key: str) -> Optional[str]:
-        return self._table.owner(shard_of(key, self.num_shards))
+        return self._table.owner(self.shard_of_key(key))
 
     def members(self) -> List[str]:
         with self._lock:
@@ -665,7 +678,7 @@ class ClusterSharding:
     def route(self, type_name: str, key: str, payload: Any, hops: int = 0) -> None:
         """Deliver ``payload`` to the entity for ``key`` wherever it
         currently lives."""
-        shard = shard_of(key, self.num_shards)
+        shard = self.shard_of_key(key)
         home = self._table.owner(shard)
         if home is None:
             self._defer(type_name, key, payload)
@@ -702,7 +715,13 @@ class ClusterSharding:
             # message until gossip converges rather than ping-ponging.
             self._defer(type_name, key, payload)
             return
-        encoded = wire.encode_message(payload)
+        # Schema-native payload bytes when the peer negotiated the
+        # codec (runtime/schema.py), pickle otherwise — decode_message
+        # dispatches on the body's magic, so the frame never knows.
+        peer_ids = getattr(self.system.fabric, "peer_schema_ids", None)
+        encoded = wire.encode_message_schema(
+            payload, peer_ids(home) if peer_ids is not None else ()
+        )
         if not self._send_frame(
             home, wire.encode_entity_frame(type_name, key, hops + 1, encoded)
         ):
@@ -731,8 +750,24 @@ class ClusterSharding:
         return True
 
     def _on_transport_frame(self, from_address: str, frame: tuple) -> None:
-        # Transport receive thread: hop onto the coordinator so all
-        # control work is serialized on one cell.
+        # Entity traffic is the hot path and needs none of the
+        # coordinator's serialization: route() is lock-protected and
+        # already runs on arbitrary sender threads (every local
+        # EntityRef.tell), so inbound "ent" frames decode + route
+        # directly on the transport thread — the per-link FIFO is
+        # preserved (one receive thread per link), and a whole
+        # cluster's entity stream no longer funnels through ONE
+        # GIL-serialized coordinator mailbox.  Reordering against a
+        # trailing control frame is benign by construction: an "ent"
+        # overtaken by its peer's "sgrant" would at worst deliver
+        # where it previously buffered (the hold is an optimization
+        # barrier, not a correctness one in that direction), and an
+        # "ent" processed early simply buffers until the grant lands.
+        if frame[0] == "ent":
+            self._handle_ent_frame(from_address, frame)
+            return
+        # Control work (tables, migration, grants) stays serialized on
+        # the coordinator cell.
         self._coordinator.tell(_FrameMsg(from_address, frame))
 
     # -- coordinator-side handlers ----------------------------------- #
@@ -1033,6 +1068,27 @@ class ClusterSharding:
         self._grant_ready()
         self._flush_deferred()
 
+    def _handle_ent_frame(self, from_address: str, frame: tuple) -> None:
+        """One entity-routed message: decode the payload (schema or
+        pickle, by magic) and route.  Runs on the transport receive
+        thread (hot path) or the coordinator (local loopback sends)."""
+        decoded = wire.decode_entity_frame(frame)
+        if decoded is None:
+            return
+        type_name, key, hops, payload_bytes = decoded
+        try:
+            payload = wire.decode_message(self._codec, payload_bytes)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return
+        if self.home_of(key) != self.address and events.recorder.enabled:
+            events.recorder.commit(
+                events.SHARD_FORWARDED, key=key, type=type_name, hops=hops
+            )
+        self.route(type_name, key, payload, hops=hops)
+
     def _handle_frame(self, from_address: str, frame: tuple) -> None:
         kind = frame[0]
         if kind == "shard":
@@ -1040,22 +1096,7 @@ class ClusterSharding:
             if decoded is not None:
                 self._adopt_table(*decoded)
         elif kind == "ent":
-            decoded = wire.decode_entity_frame(frame)
-            if decoded is None:
-                return
-            type_name, key, hops, payload_bytes = decoded
-            try:
-                payload = wire.decode_message(self._codec, payload_bytes)
-            except Exception:
-                import traceback
-
-                traceback.print_exc()
-                return
-            if self.home_of(key) != self.address and events.recorder.enabled:
-                events.recorder.commit(
-                    events.SHARD_FORWARDED, key=key, type=type_name, hops=hops
-                )
-            self.route(type_name, key, payload, hops=hops)
+            self._handle_ent_frame(from_address, frame)
         elif kind == "mig":
             self.migrations.apply_incoming(from_address, frame)
         elif kind == "miga":

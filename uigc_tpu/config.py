@@ -129,6 +129,36 @@ DEFAULTS: Dict[str, Any] = {
     # Maximum frames coalesced into one batch flush (bounds worst-case
     # batch latency and the receiver's per-unit work).
     "uigc.node.max-batch-frames": 256,
+    # Schema-native wire codec (runtime/schema.py): known message
+    # shapes cross the link as fixed binary envelopes + a marshal value
+    # plane, batch-encoded per writer drain, instead of per-message
+    # pickle.  Negotiated in the hello caps (like "fb"); peers that
+    # never advertised a matching schema table — or message types no
+    # schema fits — transparently fall back to pickle, so mixed-version
+    # links keep working.  Off, this node neither advertises nor emits
+    # schema frames.
+    "uigc.node.schema-codec": True,
+    # Shared-memory ring transport for co-located peers (runtime/
+    # shm_ring.py): when both sides advertise the "shm" capability and
+    # the link is loopback, the dialer creates a pair of SPSC byte
+    # rings and traffic leaves the socket entirely (same framing, same
+    # seq/FaultPlan/dead-letter semantics; the socket stays open as the
+    # fallback and EOF detector).  Off by default: the bench and
+    # co-located deployments opt in.
+    "uigc.node.shm-transport": False,
+    # Byte capacity of each shm ring direction.  A full ring
+    # backpressures the writer (uigc_shm_ring_full_total); a peer that
+    # stops draining AND whose process died flips the link back to the
+    # socket path.
+    "uigc.node.shm-ring-bytes": 1 << 20,
+    # Per-peer decode workers (runtime/dispatcher.py DecodeLane):
+    # "off" decodes inbound units inline on the link's receive thread
+    # (the classic path); "on" hands each peer's units to a dedicated
+    # decode worker so decode + delivery leave the transport thread;
+    # "auto" enables workers only when the interpreter can actually run
+    # them in parallel (free-threaded 3.13t; the stock GIL gains
+    # nothing from the extra hop and stays inline).
+    "uigc.node.decode-workers": "auto",
     # --- Cluster sharding (uigc_tpu/cluster; no reference analogue —
     # the reference stops at GC middleware, this is the serving layer
     # above it) ---

@@ -222,6 +222,22 @@ class Ingress:
                 entry = self.entries[wid] = self._make_entry(wid)
             entry.on_message(recipient, msg.refs)
 
+    def on_messages(self, recipient: "ActorCell", msgs: list) -> None:
+        """Bulk admission tally for a delivered run (runtime/node.py
+        ``_admit_app_run``): one gateway call per burst instead of one
+        per message — same per-message semantics, the loop just lives
+        inside the gateway."""
+        entries = self.entries
+        for msg in msgs:
+            if isinstance(msg, AppMsg):
+                wid = msg.window_id
+                if wid > self._max_window:
+                    self._max_window = wid
+                entry = entries.get(wid)
+                if entry is None:
+                    entry = entries[wid] = self._make_entry(wid)
+                entry.on_message(recipient, msg.refs)
+
     def _send(self, entry: IngressEntry) -> None:
         from .collector import LocalIngressEntry
 

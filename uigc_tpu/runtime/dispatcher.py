@@ -12,11 +12,106 @@ from __future__ import annotations
 import heapq
 import itertools
 import queue
+import sys
 import threading
 import traceback
+from collections import deque
 from typing import Any, Callable, Dict, Optional
 
 from ..utils import events
+
+
+def free_threading_active() -> bool:
+    """True when this interpreter runs threads truly concurrently (a
+    free-threaded 3.13t build with the GIL actually disabled).  The
+    stock GIL returns False — the signal ``"auto"`` dispatch modes use
+    to skip thread hops that could never pay for themselves."""
+    probe = getattr(sys, "_is_gil_enabled", None)
+    return probe is not None and not probe()
+
+
+def subinterpreters_available() -> bool:
+    """True when the per-interpreter-GIL subinterpreter API exists
+    (3.12+ ``_interpreters``/``_xxsubinterpreters``).  Detection only:
+    the decode plane stays thread-based until the isolated-heap story
+    (no shared cells across interpreters) is worth the copy."""
+    for name in ("_interpreters", "_xxsubinterpreters"):
+        try:
+            __import__(name)
+            return True
+        except ImportError:
+            continue
+    return False
+
+
+class DecodeLane:
+    """A bounded SPSC work lane: one dedicated consumer thread draining
+    a deque of (fn, arg) jobs in submission order.
+
+    This is the transport's decode offload (``uigc.node.decode-workers``):
+    the link receive thread hands each inbound wire unit to its peer's
+    lane and returns to the socket immediately, so payload decode and
+    mailbox delivery run on a per-peer worker — truly concurrently
+    across peers on a free-threaded interpreter, and still correct
+    (just serialized) under the stock GIL.  The handoff discipline is
+    the writer queue's, mirrored: producers pay one lock-free deque
+    append plus an Event.set on the empty->nonempty transition; the
+    single consumer pops in order, which therefore IS delivery order."""
+
+    def __init__(self, name: str, origin: Optional[str] = None, high_water: int = 4096):
+        self._q: deque = deque()
+        self._ev = threading.Event()
+        self._closed = False
+        self._origin = origin
+        self._high_water = high_water
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def submit(self, fn: Callable[[Any], None], arg: Any) -> None:
+        if self._closed:
+            return
+        if len(self._q) >= self._high_water:
+            # Backpressure (rare): stall the producing link thread
+            # briefly rather than queueing unboundedly — the same
+            # policy as the writer queue's high-water mark.
+            import time
+
+            while len(self._q) >= self._high_water and not self._closed:
+                self._ev.set()
+                time.sleep(0.001)
+        self._q.append((fn, arg))
+        if not self._ev.is_set():
+            self._ev.set()
+
+    def depth(self) -> int:
+        return len(self._q)
+
+    def _run(self) -> None:
+        events.set_thread_origin(self._origin)
+        q = self._q
+        while True:
+            if not q:
+                self._ev.clear()
+                if q:
+                    self._ev.set()
+                elif self._closed:
+                    return
+                else:
+                    self._ev.wait()
+                    continue
+            try:
+                fn, arg = q.popleft()
+            except IndexError:  # pragma: no cover - defensive
+                continue
+            try:
+                fn(arg)
+            except Exception:  # pragma: no cover - keep the lane alive
+                traceback.print_exc()
+
+    def close(self, timeout_s: float = 2.0) -> None:
+        self._closed = True
+        self._ev.set()
+        self._thread.join(timeout=timeout_s)
 
 
 class Dispatcher:

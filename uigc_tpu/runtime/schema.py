@@ -1,0 +1,536 @@
+"""Schema-native wire codec: fixed binary envelopes for known message
+schemas, negotiated per link, with pickle as the universal fallback.
+
+PR 5 moved payload encoding off every sender thread onto the per-peer
+writer, but the encoding itself stayed pickle: a full protocol dispatch
+through ``persistent_id`` per object, per message.  This module replaces
+that on the hot path for *known* message shapes:
+
+- the **envelope** (CRGC ``AppMsg`` / MAC ``MacAppMsg`` bookkeeping:
+  window id, external flag, carried refs) is struct-packed into a fixed
+  binary layout — no protocol machinery at all;
+- the **payload** rides the *value plane*: ``marshal`` (CPython's C
+  serializer for code objects) over a payload tree that a cheap
+  exact-type walk has proven to contain only plain scalar/container
+  types.  The walk is the safety gate: ``marshal`` would silently
+  flatten a namedtuple (or any tuple/list/dict subclass) into its base
+  container, so anything that is not *exactly* a builtin value type
+  falls back to pickle, which preserves classes;
+- a **run** form batch-encodes K consecutive messages to one recipient
+  as ONE marshal call (the propagation-blocking idea from the trace
+  plane applied to the codec: bin by destination, then vectorize) —
+  the per-message Python cost collapses to the safety walk.
+
+Negotiation follows the ``"fb"`` discipline exactly: the hello's caps
+tuple grows one element (:func:`capability`), tolerant in both
+directions.  The element pins the schema-table version AND the
+interpreter version, because the value plane is marshal: a peer whose
+cap does not match ours byte-for-byte simply gets pickle, so
+mixed-version links keep working and a schema this build does not know
+can never reach the wire.  Schema ids the peer did not advertise are
+never used toward it (:func:`peer_schema_ids`).
+
+Security note: the value plane is only ever decoded on frames from a
+handshaken peer — the same trust domain as the pickle fallback (which
+is strictly more powerful), so this narrows, never widens, what a peer
+can make us execute.
+"""
+
+from __future__ import annotations
+
+import marshal
+import struct
+import sys
+from typing import TYPE_CHECKING, Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .fabric import Fabric
+
+# ------------------------------------------------------------------- #
+# The value plane: exact-type-gated marshal
+# ------------------------------------------------------------------- #
+
+#: Types the value plane accepts as-is.  EXACT types only — subclasses
+#: (namedtuples, IntEnum, bool-like flags, dict subclasses) would lose
+#: their class through marshal, so they are rejected by the walk and
+#: travel by pickle instead.
+_SCALARS = (type(None), bool, int, float, str, bytes)
+_SCALAR_SET = frozenset(_SCALARS)
+
+#: marshal ints are bounded on some builds; anything outside int64
+#: falls back to pickle so the bound never matters on the wire.
+_I64_MIN, _I64_MAX = -(2**63), 2**63 - 1
+
+
+def value_safe(value: Any, _depth: int = 0) -> bool:
+    """True when ``value`` is a tree of *exactly* builtin value types
+    (the marshal-safe closed set).  This is the schema codec's
+    admission gate; everything else pickles.
+
+    The scalar checks for container CHILDREN are inlined rather than
+    recursive: this walk runs once per message on the writer's hot
+    loop, and a flat tuple — the dominant message shape — must cost
+    one call, not one per element."""
+    t = type(value)
+    if t in _SCALAR_SET:
+        return t is not int or (_I64_MIN <= value <= _I64_MAX)
+    if _depth > 16:
+        return False
+    scalars = _SCALAR_SET
+    if t is tuple or t is list:
+        for item in value:
+            ti = type(item)
+            if ti in scalars:
+                if ti is int and not (_I64_MIN <= item <= _I64_MAX):
+                    return False
+            elif not value_safe(item, _depth + 1):
+                return False
+        return True
+    if t is dict:
+        for k, v in value.items():
+            tk = type(k)
+            if tk in scalars:
+                if tk is int and not (_I64_MIN <= k <= _I64_MAX):
+                    return False
+            elif not value_safe(k, _depth + 1):
+                return False
+            tv = type(v)
+            if tv in scalars:
+                if tv is int and not (_I64_MIN <= v <= _I64_MAX):
+                    return False
+            elif not value_safe(v, _depth + 1):
+                return False
+        return True
+    return False
+
+
+def encode_value(value: Any) -> bytes:
+    """marshal the (pre-gated) value.  Callers must have passed
+    :func:`value_safe` first."""
+    return marshal.dumps(value, 4)
+
+
+def decode_value(data: bytes) -> Any:
+    return marshal.loads(data)
+
+
+# ------------------------------------------------------------------- #
+# Ref tokens (the envelope plane's cross-heap handles)
+# ------------------------------------------------------------------- #
+
+_TOKEN_HDR = struct.Struct(">HQ")  # (len(address), uid)
+
+
+def _pack_cell_token(parts: List[bytes], cell: Any) -> None:
+    address = cell.system.address.encode()
+    parts.append(_TOKEN_HDR.pack(len(address), cell.uid))
+    parts.append(address)
+
+
+def _unpack_cell_token(body: bytes, off: int) -> Tuple[str, int, int]:
+    alen, uid = _TOKEN_HDR.unpack_from(body, off)
+    off += _TOKEN_HDR.size
+    address = body[off : off + alen].decode()
+    return address, uid, off + alen
+
+
+def _resolve_cell(fabric: "Fabric", address: str, uid: int):
+    hook = getattr(fabric, "resolve_cell_token", None)
+    if hook is not None:
+        return hook(address, uid)
+    system = fabric.systems.get(address)
+    if system is None:
+        raise LookupError(f"unknown system {address!r} on this fabric")
+    cell = system.resolve_cell(uid)
+    if cell is None:
+        raise LookupError(f"no cell uid={uid} in {address!r}")
+    return cell
+
+
+# ------------------------------------------------------------------- #
+# Schema registry
+# ------------------------------------------------------------------- #
+
+
+class Schema:
+    """One registered message schema: an exact envelope type, a probe/
+    encode pair and the matching decode, plus the vectorized run forms.
+
+    ``probe(msg)`` is the cheap run-admission gate: True means the
+    instance WILL encode under the vectorized form, so ``vec_encode``
+    may trust its inputs and skip per-message re-validation (one
+    safety walk per message, not two).  ``encode`` is the standalone
+    single-message form and carries its own checks."""
+
+    __slots__ = (
+        "schema_id",
+        "type_name",
+        "probe",
+        "encode",
+        "decode",
+        "vec_encode",
+        "vec_decode",
+    )
+
+    def __init__(
+        self,
+        schema_id: int,
+        type_name: str,
+        probe: Callable[[Any], bool],
+        encode: Callable[[Any], Optional[bytes]],
+        decode: Callable[["Fabric", bytes], Any],
+        vec_encode: Callable[[List[Any]], Optional[bytes]],
+        vec_decode: Callable[["Fabric", bytes], List[Any]],
+    ):
+        self.schema_id = schema_id
+        self.type_name = type_name
+        self.probe = probe
+        self.encode = encode
+        self.decode = decode
+        self.vec_encode = vec_encode
+        self.vec_decode = vec_decode
+
+
+class SchemaRegistry:
+    """schema_id -> Schema, plus the exact-envelope-type dispatch used
+    on the encode side.  ``register`` is open for future message shapes;
+    ids < 64 are reserved for the built-ins below."""
+
+    def __init__(self) -> None:
+        self._by_id: Dict[int, Schema] = {}
+        self._by_type: Dict[type, Schema] = {}
+
+    def register(self, schema: Schema, envelope_type: Optional[type] = None) -> Schema:
+        self._by_id[schema.schema_id] = schema
+        if envelope_type is not None:
+            self._by_type[envelope_type] = schema
+        return schema
+
+    def ids(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._by_id))
+
+    def get(self, schema_id: int) -> Optional[Schema]:
+        return self._by_id.get(schema_id)
+
+    def for_message(self, msg: Any) -> Optional[Schema]:
+        """The schema that *may* encode ``msg`` (by exact envelope
+        type); the schema's own encode still returns None when the
+        instance does not fit (e.g. an unencodable payload)."""
+        return self._by_type.get(type(msg))
+
+
+# ------------------------------------------------------------------- #
+# Built-in schemas
+# ------------------------------------------------------------------- #
+
+SCHEMA_VAL = 1  # a bare value-plane message (unmanaged/raw sends)
+SCHEMA_CRGC_APP = 2  # CRGC AppMsg envelope
+SCHEMA_MAC_APP = 3  # MAC MacAppMsg envelope
+
+_APP_HDR = struct.Struct(">qBH")  # (window_id, flags, n_refs)
+
+_CRGC_CLASSES: Optional[tuple] = None
+
+
+def _crgc_classes() -> tuple:
+    global _CRGC_CLASSES
+    if _CRGC_CLASSES is None:
+        from ..engines.crgc.messages import AppMsg
+        from ..engines.crgc.refob import CrgcRefob
+
+        _CRGC_CLASSES = (AppMsg, CrgcRefob)
+    return _CRGC_CLASSES
+
+
+_MAC_CLASSES: Optional[tuple] = None
+
+
+def _mac_classes() -> tuple:
+    global _MAC_CLASSES
+    if _MAC_CLASSES is None:
+        from ..engines.mac.engine import MacAppMsg, MacRefob
+
+        _MAC_CLASSES = (MacAppMsg, MacRefob)
+    return _MAC_CLASSES
+
+
+def _encode_val(msg: Any) -> Optional[bytes]:
+    if not value_safe(msg):
+        return None
+    return encode_value(msg)
+
+
+def _decode_val(fabric: "Fabric", body: bytes) -> Any:
+    return decode_value(body)
+
+
+def _vec_encode_val(msgs: List[Any]) -> Optional[bytes]:
+    # Inputs pre-gated by probe (= value_safe) on the run-admission path.
+    return encode_value(msgs)
+
+
+def _vec_decode_val(fabric: "Fabric", body: bytes) -> List[Any]:
+    out = decode_value(body)
+    if type(out) is not list:
+        raise ValueError("schema run body did not decode to a list")
+    return out
+
+
+def _refs_tokens(refs: tuple, refob_type: type) -> Optional[List[Any]]:
+    """The ref targets of an app envelope, or None when any ref is not
+    the engine's own refob over a token-able cell."""
+    cells = []
+    for ref in refs:
+        if type(ref) is not refob_type:
+            return None
+        target = getattr(ref, "target", None)
+        system = getattr(target, "system", None)
+        if target is None or system is None:
+            return None
+        cells.append(target)
+    return cells
+
+
+def _encode_app(msg: Any, window_id: int, flags: int, refs: tuple, refob_type: type) -> Optional[bytes]:
+    payload = msg.payload
+    if not value_safe(payload):
+        return None
+    cells = _refs_tokens(refs, refob_type)
+    if cells is None or len(cells) > 0xFFFF:
+        return None
+    if not (_I64_MIN <= window_id <= _I64_MAX):
+        return None
+    parts: List[bytes] = [_APP_HDR.pack(window_id, flags, len(cells))]
+    for cell in cells:
+        _pack_cell_token(parts, cell)
+    parts.append(encode_value(payload))
+    return b"".join(parts)
+
+
+def _decode_app_header(fabric: "Fabric", body: bytes):
+    window_id, flags, n_refs = _APP_HDR.unpack_from(body, 0)
+    off = _APP_HDR.size
+    cells = []
+    for _ in range(n_refs):
+        address, uid, off = _unpack_cell_token(body, off)
+        cells.append(_resolve_cell(fabric, address, uid))
+    return window_id, flags, cells, off
+
+
+def _encode_crgc_app(msg: Any) -> Optional[bytes]:
+    AppMsg, CrgcRefob = _crgc_classes()
+    return _encode_app(
+        msg, msg.window_id, 1 if msg.external else 0, msg._refs, CrgcRefob
+    )
+
+
+def _decode_crgc_app(fabric: "Fabric", body: bytes) -> Any:
+    AppMsg, CrgcRefob = _crgc_classes()
+    window_id, flags, cells, off = _decode_app_header(fabric, body)
+    msg = AppMsg(
+        decode_value(body[off:]),
+        [CrgcRefob(cell) for cell in cells],
+        external=bool(flags & 1),
+    )
+    msg.window_id = window_id
+    return msg
+
+
+def _probe_crgc_app(msg: Any) -> bool:
+    return (
+        not msg._refs
+        and _I64_MIN <= msg.window_id <= _I64_MAX
+        and value_safe(msg.payload)
+    )
+
+
+def _vec_encode_crgc_app(msgs: List[Any]) -> Optional[bytes]:
+    """Run form: only the all-refs-empty case vectorizes (refs force
+    per-message token work anyway); body is ONE marshal call over
+    [(window_id, external, payload), ...].  Inputs pre-gated by probe."""
+    return encode_value([(m.window_id, m.external, m.payload) for m in msgs])
+
+
+def _vec_decode_crgc_app(fabric: "Fabric", body: bytes) -> List[Any]:
+    AppMsg, _CrgcRefob = _crgc_classes()
+    rows = decode_value(body)
+    if type(rows) is not list:
+        raise ValueError("schema run body did not decode to a list")
+    out = []
+    for wid, external, payload in rows:
+        msg = AppMsg(payload, (), external=bool(external))
+        msg.window_id = wid
+        out.append(msg)
+    return out
+
+
+def _encode_mac_app(msg: Any) -> Optional[bytes]:
+    MacAppMsg, MacRefob = _mac_classes()
+    flags = (1 if msg.external else 0) | (2 if msg.is_self_msg else 0)
+    return _encode_app(msg, 0, flags, msg._refs, MacRefob)
+
+
+def _decode_mac_app(fabric: "Fabric", body: bytes) -> Any:
+    MacAppMsg, MacRefob = _mac_classes()
+    _window_id, flags, cells, off = _decode_app_header(fabric, body)
+    return MacAppMsg(
+        decode_value(body[off:]),
+        [MacRefob(cell) for cell in cells],
+        is_self_msg=bool(flags & 2),
+        external=bool(flags & 1),
+    )
+
+
+def _probe_mac_app(msg: Any) -> bool:
+    return not msg._refs and value_safe(msg.payload)
+
+
+def _vec_encode_mac_app(msgs: List[Any]) -> Optional[bytes]:
+    # Inputs pre-gated by probe.
+    return encode_value([(m.is_self_msg, m.external, m.payload) for m in msgs])
+
+
+def _vec_decode_mac_app(fabric: "Fabric", body: bytes) -> List[Any]:
+    MacAppMsg, _MacRefob = _mac_classes()
+    rows = decode_value(body)
+    if type(rows) is not list:
+        raise ValueError("schema run body did not decode to a list")
+    return [
+        MacAppMsg(payload, (), is_self_msg=bool(s), external=bool(e))
+        for s, e, payload in rows
+    ]
+
+
+def _build_default_registry() -> SchemaRegistry:
+    registry = SchemaRegistry()
+    registry.register(
+        Schema(
+            SCHEMA_VAL,
+            "val",
+            value_safe,
+            _encode_val,
+            _decode_val,
+            _vec_encode_val,
+            _vec_decode_val,
+        )
+    )
+    registry.register(
+        Schema(
+            SCHEMA_CRGC_APP,
+            "crgc-app",
+            _probe_crgc_app,
+            _encode_crgc_app,
+            _decode_crgc_app,
+            _vec_encode_crgc_app,
+            _vec_decode_crgc_app,
+        )
+    )
+    registry.register(
+        Schema(
+            SCHEMA_MAC_APP,
+            "mac-app",
+            _probe_mac_app,
+            _encode_mac_app,
+            _decode_mac_app,
+            _vec_encode_mac_app,
+            _vec_decode_mac_app,
+        )
+    )
+    return registry
+
+
+#: The process-wide registry every NodeFabric shares.  Envelope-type
+#: dispatch is lazy (``classify``) so importing this module never pulls
+#: the engines in.
+registry = _build_default_registry()
+
+
+def classify(msg: Any) -> Optional[Schema]:
+    """The schema that may encode ``msg``: exact-type envelope match,
+    else the bare value plane for plain values."""
+    t = type(msg)
+    schema = registry._by_type.get(t)
+    if schema is not None:
+        return schema
+    if not registry._by_type:
+        _warm_envelope_types()
+        schema = registry._by_type.get(t)
+        if schema is not None:
+            return schema
+    if t in _SCALAR_SET or t is tuple or t is list or t is dict:
+        return registry.get(SCHEMA_VAL)
+    return None
+
+
+_VALUE_TYPES = _SCALARS + (tuple, list, dict)
+
+
+def encoder_table(schema_ids) -> Dict[type, Schema]:
+    """Exact-type -> Schema dispatch restricted to a negotiated id set
+    — built once per link at hello time so the writer's hot loop pays
+    ONE dict hit per message instead of classify + id-set checks."""
+    if not registry._by_type:
+        _warm_envelope_types()
+    table: Dict[type, Schema] = {}
+    val = registry.get(SCHEMA_VAL)
+    if val is not None and SCHEMA_VAL in schema_ids:
+        for t in _VALUE_TYPES:
+            table[t] = val
+    for t, sch in registry._by_type.items():
+        if sch.schema_id in schema_ids:
+            table[t] = sch
+    return table
+
+
+def _warm_envelope_types() -> None:
+    """Bind the built-in schemas to their (lazily imported) envelope
+    classes.  Called once, on the first classify of a non-value type or
+    at fabric setup — never at module import."""
+    AppMsg, _ = _crgc_classes()
+    registry._by_type.setdefault(AppMsg, registry.get(SCHEMA_CRGC_APP))
+    try:
+        MacAppMsg, _ = _mac_classes()
+        registry._by_type.setdefault(MacAppMsg, registry.get(SCHEMA_MAC_APP))
+    except Exception:  # pragma: no cover - MAC engine optional
+        pass
+
+
+# ------------------------------------------------------------------- #
+# Capability negotiation (the hello caps element)
+# ------------------------------------------------------------------- #
+
+#: Schema-table epoch: bump when a built-in schema's LAYOUT changes
+#: incompatibly (ids are additive and never need a bump).
+TABLE_VERSION = 1
+
+
+def capability() -> str:
+    """The hello caps element advertising this node's decodable schema
+    ids.  Pins the interpreter version because the value plane is
+    marshal: ``sc<table>:<py-major>.<py-minor>.<marshal-version>:<ids>``."""
+    ids = ",".join(str(i) for i in registry.ids())
+    return (
+        f"sc{TABLE_VERSION}:"
+        f"{sys.version_info[0]}.{sys.version_info[1]}.{marshal.version}:{ids}"
+    )
+
+
+def peer_schema_ids(caps: Iterable[str]) -> frozenset:
+    """The schema ids a peer's hello advertised AND this build can
+    encode — empty when the peer is not schema-capable or its value
+    plane is not byte-compatible with ours (different interpreter or
+    table version: pickle fallback, never a guess)."""
+    ours = capability()
+    prefix, _, _ = ours.rpartition(":")
+    for cap in caps:
+        if not isinstance(cap, str) or not cap.startswith("sc"):
+            continue
+        theirs_prefix, _, ids_part = cap.rpartition(":")
+        if theirs_prefix != prefix:
+            return frozenset()
+        try:
+            theirs = {int(x) for x in ids_part.split(",") if x}
+        except ValueError:
+            return frozenset()
+        return frozenset(theirs & set(registry.ids()))
+    return frozenset()

@@ -225,7 +225,52 @@ def encode_message(msg: Any) -> bytes:
     return data
 
 
+#: Prefix of a schema-encoded message body (runtime/schema.py).  A
+#: protocol-2+ pickle always starts with b"\x80", so the leading NUL
+#: is unambiguous: decode_message dispatches on it, which is what lets
+#: pre-encoded payload BYTES (entity frames, migration blobs) carry
+#: either codec without the frame knowing.
+SCHEMA_MAGIC = b"\x00SV"
+_SCHEMA_ID = struct.Struct(">H")
+
+_SCHEMA_MOD = None
+
+
+def _schema_mod():
+    """Lazily bound schema module (a module-load import would be fine
+    for cycles — schema imports nothing from wire — but the codec is
+    hot-path: resolve once, not through the import machinery per
+    message)."""
+    global _SCHEMA_MOD
+    if _SCHEMA_MOD is None:
+        from . import schema
+
+        _SCHEMA_MOD = schema
+    return _SCHEMA_MOD
+
+
+def encode_message_schema(msg: Any, schema_ids) -> bytes:
+    """Message bytes for a peer that advertised ``schema_ids``
+    (``NodeFabric.peer_schema_ids``): schema-native when a negotiated
+    schema fits the message, pickle otherwise.  NEVER emit schema bytes
+    toward a peer that did not advertise the id — an old build's
+    decode_message would reject the magic as garbage."""
+    if schema_ids:
+        sch = _schema_mod().classify(msg)
+        if sch is not None and sch.schema_id in schema_ids:
+            body = sch.encode(msg)
+            if body is not None:
+                return SCHEMA_MAGIC + _SCHEMA_ID.pack(sch.schema_id) + body
+    return encode_message(msg)
+
+
 def decode_message(fabric: "Fabric", data: bytes) -> Any:
+    if data[:3] == SCHEMA_MAGIC:
+        (schema_id,) = _SCHEMA_ID.unpack_from(data, 3)
+        sch = _schema_mod().registry.get(schema_id)
+        if sch is None:
+            raise LookupError(f"unknown wire schema id {schema_id}")
+        return sch.decode(fabric, data[5:])
     return _Unpickler(io.BytesIO(data), fabric).load()
 
 
@@ -282,11 +327,32 @@ def encode_block(inner: tuple, truncate: bool = False) -> bytes:
     return block
 
 
+#: Schema-run block (runtime/schema.py): K consecutive app frames to
+#: ONE uid, batch-encoded under one negotiated schema id.  The frame
+#: slot's sequence number is the FIRST message's; the run consumes
+#: ``count`` contiguous sequence numbers (receiver: _on_batch).
+#:
+#:   block := b"R" ">QIHH"(uid, len(body), schema_id, count) body
+_RUN_HDR = struct.Struct(">QIHH")
+
+
+def encode_run_block(uid: int, schema_id: int, count: int, body: bytes) -> bytes:
+    return b"R" + _RUN_HDR.pack(uid, len(body), schema_id, count) + body
+
+
 def decode_block(block: bytes):
     """-> the inner frame tuple, or None when the block is corrupt."""
     if not block:
         return None
     kind = block[0:1]
+    if kind == b"R":
+        if len(block) < 1 + _RUN_HDR.size:
+            return None
+        uid, blen, schema_id, count = _RUN_HDR.unpack_from(block, 1)
+        body = block[1 + _RUN_HDR.size : 1 + _RUN_HDR.size + blen]
+        if len(body) != blen or count < 1:
+            return None
+        return ("appr", uid, schema_id, count, body)
     if kind == b"A":
         if len(block) < 13:
             return None

@@ -37,6 +37,10 @@ DURATION_BUCKETS = events.DURATION_BUCKET_BOUNDS_S
 #: wave, entries per wake): powers of two up to 64k.
 COUNT_BUCKETS: Tuple[float, ...] = tuple(float(2**i) for i in range(17))
 
+#: Bucket bounds for byte sizes (writer drain flushes): powers of four
+#: from 64B to ~16MB.
+BYTES_BUCKETS: Tuple[float, ...] = tuple(float(4**i * 64) for i in range(10))
+
 LabelKey = Tuple[Tuple[str, str], ...]
 
 #: Default per-metric labelset bound (``uigc.telemetry.max-labelsets``).
@@ -437,6 +441,21 @@ class EventMetricsBridge:
             "uigc_send_failed_total",
             "Frames lost after sequence assignment (link broke mid-flush).",
         )
+        self._drain_bytes = r.histogram(
+            "uigc_writer_drain_bytes",
+            "Wire bytes per peer-writer flush (one sendall / ring record).",
+            buckets=BYTES_BUCKETS,
+        )
+        self._codec_frames = r.counter(
+            "uigc_codec_frames_total",
+            "App frames encoded per wire codec (schema-native vs pickle "
+            "fallback; runtime/schema.py).",
+        )
+        self._shm_ring_full = r.counter(
+            "uigc_shm_ring_full_total",
+            "Writer stalls on a full co-located shm ring (backpressure; "
+            "runtime/shm_ring.py).",
+        )
         self._node_down = r.counter(
             "uigc_node_down_total", "Peer-death verdicts, by reason."
         )
@@ -537,17 +556,31 @@ class EventMetricsBridge:
         elif name == events.FRAME_GAP:
             self._frame_gaps.inc(fields.get("missed", 1), src=fields.get("src", ""))
         elif name == events.FRAME_DUPLICATE:
-            self._frame_dups.inc(src=fields.get("src", ""))
+            self._frame_dups.inc(fields.get("count", 1), src=fields.get("src", ""))
         elif name == events.FRAME_DROPPED:
             self._frames_dropped.inc()
         elif name == events.FRAME_CORRUPT:
-            self._frames_corrupt.inc()
+            self._frames_corrupt.inc(fields.get("count", 1))
         elif name == events.FRAME_BATCH:
             size = fields.get("size")
             if size is not None:
                 self._batch_size.observe(size)
+            nbytes = fields.get("bytes")
+            if nbytes is not None:
+                self._drain_bytes.observe(nbytes)
+        elif name == events.CODEC_FRAMES:
+            schema_n = fields.get("schema", 0)
+            pickle_n = fields.get("pickle", 0)
+            if schema_n:
+                self._codec_frames.inc(schema_n, codec="schema")
+            if pickle_n:
+                self._codec_frames.inc(pickle_n, codec="pickle")
+        elif name == events.SHM_RING_FULL:
+            self._shm_ring_full.inc(dst=fields.get("dst", ""))
         elif name == events.SEND_FAILED:
-            self._send_failed.inc(kind=fields.get("kind", "?"))
+            self._send_failed.inc(
+                fields.get("count", 1), kind=fields.get("kind", "?")
+            )
         elif name == events.NODE_DOWN:
             self._node_down.inc(reason=fields.get("reason", "?"))
         elif name == events.NODE_SUSPECT:

@@ -111,6 +111,19 @@ FRAME_BATCH = "fabric.frame_batch"
 #: event is the sender-side record that replaces the old silent
 #: bool-only ``send_frame`` failure path.
 SEND_FAILED = "fabric.send_failed"
+#: per-writer-drain codec mix (fields: dst, schema=N, pickle=N app
+#: frames) — feeds ``uigc_codec_frames_total{codec=...}`` so the
+#: schema-vs-pickle ratio on each link is observable (runtime/node.py).
+CODEC_FRAMES = "fabric.codec_frames"
+#: a co-located shm ring pair went live for a peer direction (fields:
+#: dst, role="producer"|"consumer") — runtime/shm_ring.py negotiation.
+SHM_ESTABLISHED = "fabric.shm_established"
+#: the producer found its shm ring full and stalled (fields: dst) —
+#: the ring-backpressure signal (``uigc_shm_ring_full_total``).
+SHM_RING_FULL = "fabric.shm_ring_full"
+#: a live shm ring was renounced and the link fell back to the socket
+#: path (fields: dst, reason="peer-dead"|"poisoned"|"write-failed").
+SHM_FALLBACK = "fabric.shm_fallback"
 UNDO_FOLD = "crgc.undo_fold"
 
 # Cluster-sharding events (ours; uigc_tpu/cluster).  Emitted by the
