@@ -84,6 +84,19 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
             Metric("sweeps_mean", "lower", 0.40),
         ],
     ),
+    # Device plane (telemetry/device.py + tools/device_report.py): the
+    # TPU-session artifacts gate the same figures the wake-budget
+    # explainer decomposes.  Rounds that predate wake_chain_bench (or
+    # whole sessions the tunnel outage kept CPU-only) simply lack the
+    # keys and SKIP — a missing metric must never read as a pass.
+    "DEVICE": (
+        "BENCH_TPU_SESSION_r*.json",
+        [
+            Metric("device_per_wake_ms", "lower", 0.40),
+            Metric("device_per_sweep_ms", "lower", 0.40),
+            Metric("sweeps_mean", "lower", 0.40),
+        ],
+    ),
 }
 
 

@@ -20,6 +20,12 @@ Output: ``--format prom`` (default; Prometheus text exposition) or
 stored series (every labelset fan-out) as an ASCII sparkline + stats,
 from a live ``/timeseries`` endpoint (``--url``) or a JSONL replay
 (``--from-jsonl``) — the renderers are shared with ``tools/uigc_top.py``.
+
+``--device`` renders the device-plane observatory
+(``uigc.telemetry.device``): from a live ``/device`` endpoint
+(``--url``) or by replaying the event-fed planes (compile cache, host
+transfers, donation audit) out of a JSONL sink — the renderers are
+``tools/device_report.py``'s.
 """
 
 from __future__ import annotations
@@ -217,6 +223,43 @@ def dump_series(name, url, jsonl, fmt) -> int:
     return 0
 
 
+def dump_device(url, jsonl, fmt) -> int:
+    """Render the device observatory: live ``/device`` or the event-fed
+    planes replayed from a JSONL sink (tools/device_report.py
+    renderers)."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import device_report
+    import uigc_top
+
+    if url:
+        try:
+            doc = device_report.fetch_doc(url.rstrip("/"))
+        except Exception as exc:
+            print(
+                f"telemetry-dump: no /device at {url} "
+                f"(uigc.telemetry.device off?): {exc}",
+                file=sys.stderr,
+            )
+            return 1
+    else:
+        doc = uigc_top.replay_device(jsonl)
+        if doc is None:
+            print(
+                f"telemetry-dump: no replayable events in {jsonl!r}",
+                file=sys.stderr,
+            )
+            return 1
+    if fmt == "json":
+        print(json.dumps(doc, indent=2, sort_keys=True, default=repr))
+        return 0
+    print(
+        device_report.render_device_doc(
+            doc, device_report.committed_device_figures()
+        )
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="telemetry-dump", description=__doc__.splitlines()[0]
@@ -226,6 +269,12 @@ def main(argv=None) -> int:
         metavar="NAME",
         help="render one time-plane series (sparkline + stats) from "
         "--url or --from-jsonl (tools/uigc_top.py renderers)",
+    )
+    parser.add_argument(
+        "--device",
+        action="store_true",
+        help="render the device-plane observatory from --url (/device) "
+        "or --from-jsonl (tools/device_report.py renderers)",
     )
     parser.add_argument(
         "--url",
@@ -260,6 +309,10 @@ def main(argv=None) -> int:
         help="output format (default: prom)",
     )
     args = parser.parse_args(argv)
+    if args.device:
+        if not args.url and not args.from_jsonl:
+            parser.error("--device needs --url or --from-jsonl")
+        return dump_device(args.url, args.from_jsonl, args.format)
     if args.series:
         if not args.url and not args.from_jsonl:
             parser.error("--series needs --url or --from-jsonl")

@@ -94,6 +94,19 @@ UL009  metric-name-convention
     names make dashboards guess.  Registrations built from a non-literal
     first argument are not linted (nothing to check statically).
 
+UL011  unguarded-host-transfer
+    A device->host crossing idiom (``jax.device_get(...)``, a zero-arg
+    ``.item()`` call, or ``np.asarray(x)`` WITHOUT a ``dtype=`` keyword
+    — the dtype'd form is the host list-conversion idiom, the bare form
+    is how device arrays get read back) in a module under ``engines/``,
+    ``ops/`` or ``parallel/`` with no ``# readback:`` annotation on the
+    line.  Stray transfers on collector hot paths serialize the device
+    pipeline and dodge the observatory's transfer accounting
+    (uigc_tpu/telemetry/device.py); deliberate crossings route through
+    ``engines/crgc/arrays._readback`` (accounted) or carry a
+    ``# readback: <why>`` annotation.  Legacy conversion sites in the
+    ops layer are grandfathered in the allowlist.
+
 UL008  inspector-mutates-engine-state
     Snapshot/inspect code (``uigc_tpu/telemetry/inspect.py``) broke its
     read-only contract.  The liveness inspector observes the collector's
@@ -145,7 +158,11 @@ RULES = {
     "UL008": "snapshot/inspect code mutates engine state",
     "UL009": "metric name violates the uigc_ prefix / unit-suffix convention",
     "UL010": "direct pickle call on a runtime hot-path module outside wire.py",
+    "UL011": "unannotated device->host transfer on an engines/ops hot path",
 }
+
+#: UL011: module qualifiers numpy is imported under in this repo.
+_NUMPY_QUALS = {"np", "numpy", "_np"}
 
 #: UL010: the pickle entry points that bypass the schema codec.
 _PICKLE_CALLS = {"dumps", "loads", "dump", "load", "Pickler", "Unpickler"}
@@ -287,6 +304,13 @@ class _FileLinter:
         #: (outer_lock, inner_lock) -> first line observed, for UL005
         self.lock_pairs: Dict[Tuple[str, str], int] = {}
         self._suppressed = _suppressed_lines(source)
+        #: lines carrying a "# readback:" annotation (UL011 exemption —
+        #: an explicitly declared device->host crossing site)
+        self._readback_lines = {
+            i + 1
+            for i, line in enumerate(source.splitlines())
+            if "# readback:" in line
+        }
 
     def add(self, line: int, rule: str, message: str) -> None:
         codes = self._suppressed.get(line, ())
@@ -297,9 +321,11 @@ class _FileLinter:
     # -- rules ------------------------------------------------------- #
 
     def run(self, lint_asserts: bool) -> None:
-        in_runtime = "runtime" in self.path.split(os.sep)
+        parts = self.path.split(os.sep)
+        in_runtime = "runtime" in parts
         norm = self.path.replace(os.sep, "/")
         pickle_guarded = in_runtime and not norm.endswith("runtime/wire.py")
+        device_plane = bool({"engines", "ops", "parallel"} & set(parts))
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
@@ -308,6 +334,8 @@ class _FileLinter:
                     self._lint_proxycell(node)
                 if pickle_guarded:
                     self._lint_pickle_hot_path(node)
+                if device_plane:
+                    self._lint_host_transfer(node)
                 self._lint_metric_name(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
@@ -490,6 +518,45 @@ class _FileLinter:
                 "UL009",
                 f"{fn.attr} {name!r} lacks a unit suffix "
                 f"({'/'.join(_METRIC_UNIT_SUFFIXES)})",
+            )
+
+    def _lint_host_transfer(self, call: ast.Call) -> None:
+        """UL011: device->host crossing idioms under engines/, ops/ or
+        parallel/ must be annotated (``# readback: <why>``) or routed
+        through the accounted ``arrays._readback`` helper.  The flagged
+        shapes: ``jax.device_get(x)``, zero-arg ``.item()``, and
+        ``np.asarray(x)`` without a ``dtype=`` keyword (the dtype'd
+        form is host list conversion, never a readback)."""
+        if call.lineno in self._readback_lines:
+            return
+        qual, name = _call_name(call)
+        hit = None
+        if qual == "jax" and name == "device_get":
+            hit = "jax.device_get()"
+        elif (
+            name == "item"
+            # Any attribute receiver, not just a bare name — the common
+            # in-method forms are self._dev_x.item() / marks[0].item(),
+            # for which _call_name's qualifier is None.
+            and isinstance(call.func, ast.Attribute)
+            and not call.args
+            and not call.keywords
+        ):
+            hit = f"{qual or '<expr>'}.item()"
+        elif (
+            name == "asarray"
+            and qual in _NUMPY_QUALS
+            and not any(kw.arg == "dtype" for kw in call.keywords)
+        ):
+            hit = f"{qual}.asarray() without dtype="
+        if hit is not None:
+            self.add(
+                call.lineno,
+                "UL011",
+                f"{hit} on a device-plane module: a device->host "
+                "transfer here dodges the observatory's accounting; "
+                "route through arrays._readback or annotate the line "
+                "with '# readback: <why>'",
             )
 
     def _lint_pickle_hot_path(self, call: ast.Call) -> None:

@@ -3,8 +3,11 @@
 
 Renders the telemetry time plane (``uigc.telemetry.timeseries``) as a
 terminal dashboard: sparklines per key series, actor/entity/shard
-counts, firing anomaly/SLO alerts, and per-peer link health (phi,
-writer-queue depth).  Two sources:
+counts, firing anomaly/SLO alerts, per-peer link health (phi,
+writer-queue depth), and — when the node serves ``/device``
+(``uigc.telemetry.device``) — a device-observatory panel (ledger
+bytes, per-wake device time, compile hit/miss, transfer and donation
+tallies; dashes on nodes that predate the observatory).  Two sources:
 
 - ``--url http://127.0.0.1:PORT``  poll a live node's metrics HTTP
   server (``/timeseries`` + ``/alerts`` + ``/metrics.json``); add
@@ -217,12 +220,66 @@ def _gauge_value(metrics: Dict[str, Any], name: str) -> Optional[float]:
     return total
 
 
+def render_device_panel(device: Optional[Dict[str, Any]]) -> List[str]:
+    """The device-observatory rows.  A node that predates the /device
+    route (or runs with the observatory off) renders dashes — the panel
+    must degrade, never crash, on an old or un-instrumented peer."""
+    if not isinstance(device, dict):
+        return ["device: -  (observatory off, or node predates /device)"]
+    try:
+        ledger = device.get("ledger") or {}
+        compile_doc = device.get("compile") or {}
+        transfers = device.get("transfers") or {}
+        donation = device.get("donation") or {}
+        wakes = [
+            r for r in device.get("recent_wakes") or [] if r.get("device_s")
+        ]
+        if wakes:
+            mean_ms = sum(r["device_s"] for r in wakes) / len(wakes) * 1e3
+            wake_cell = f"{mean_ms:.2f}ms/wake"
+        else:
+            wake_cell = "-"
+        sweeps = [int(r["n_sweeps"]) for r in wakes if r.get("n_sweeps")]
+        sweeps_cell = (
+            f"{sum(sweeps) / len(sweeps):.1f} sweeps" if sweeps else "-"
+        )
+        lines = [
+            "device: ledger "
+            + fmt_si(ledger.get("total_bytes"))
+            + "B ("
+            + fmt_si(ledger.get("device_bytes"))
+            + "B on-device) · "
+            + wake_cell
+            + " · "
+            + sweeps_cell
+            + f" · compile {fmt_si(compile_doc.get('hits_total'))}h/"
+            + f"{fmt_si(compile_doc.get('misses_total'))}m"
+            + f" · transfers {fmt_si(transfers.get('total_count'))}"
+            + f" · donation copies {fmt_si(donation.get('copies_total'))}"
+        ]
+        families = sorted(
+            (ledger.get("families") or {}).items(),
+            key=lambda kv: -(kv[1].get("host", 0) + kv[1].get("device", 0)),
+        )[:4]
+        cells = [
+            f"{fam} {fmt_si(t.get('host', 0) + t.get('device', 0))}B"
+            for fam, t in families
+            if t.get("host", 0) + t.get("device", 0)
+        ]
+        if cells:
+            lines.append("  " + "  ".join(cells))
+        return lines
+    except Exception:
+        return ["device: -  (unreadable /device document)"]
+
+
 def render_dashboard(
     tsdoc: Dict[str, Any],
     alerts: Optional[Dict[str, Any]] = None,
     metrics: Optional[Dict[str, Any]] = None,
     width: int = 48,
     source: str = "",
+    device: Optional[Dict[str, Any]] = None,
 ) -> str:
     """The full dashboard frame as plain text."""
     lines: List[str] = []
@@ -295,6 +352,8 @@ def render_dashboard(
                 f"  {peer:<28} phi {fmt_si(phi):>7}  "
                 f"queue {fmt_si(health.get('queue')):>7}  [{state}]"
             )
+    lines.append("")
+    lines.extend(render_device_panel(device))
     firing = (alerts or {}).get("firing", [])
     lines.append("")
     if firing:
@@ -335,6 +394,40 @@ def fetch_live(
     if tsdoc is None:
         raise ConnectionError(f"no /timeseries at {base} (timeseries off?)")
     return tsdoc, get("/alerts"), get("/metrics.json")
+
+
+def fetch_device(base: str) -> Optional[Dict[str, Any]]:
+    """The /device observatory doc, or None on a node that predates it
+    or runs with ``uigc.telemetry.device`` off — the device panel
+    renders dashes for None, never raises."""
+    try:
+        with urllib.request.urlopen(base + "/device", timeout=5) as rsp:
+            return json.loads(rsp.read())
+    except Exception:
+        return None
+
+
+def replay_device(path: str) -> Optional[Dict[str, Any]]:
+    """Rebuild the event-fed observatory planes (compile cache, host
+    transfers, donation audit) from a persisted JSONL sink — the memory
+    ledger needs a live graph and stays empty offline."""
+    try:
+        from uigc_tpu.telemetry.device import DeviceObservatory
+        from uigc_tpu.telemetry.exporter import replay_jsonl
+
+        # Unscoped (node="") so the origin filter accepts the sink's
+        # events — every persisted line carries the live node's origin
+        # tag, which a "replay:<file>" node name would reject wholesale.
+        obs = DeviceObservatory(node="")
+        try:
+            for name, fields in replay_jsonl(path):
+                obs(name, fields)
+            obs.node = f"replay:{Path(path).name}"  # display only
+            return obs.to_doc()
+        finally:
+            obs.close()
+    except Exception:
+        return None
 
 
 def replay_model(
@@ -398,7 +491,8 @@ def _curses_loop(args) -> int:
                     args.url, merged=args.merged, window=args.window
                 )
                 frame = render_dashboard(
-                    tsdoc, alerts, metrics, width=args.width, source=args.url
+                    tsdoc, alerts, metrics, width=args.width, source=args.url,
+                    device=fetch_device(args.url),
                 )
             except Exception as exc:
                 frame = f"uigc-top · {args.url}\n\nno data: {exc}\nretrying…"
@@ -462,6 +556,7 @@ def main(argv=None) -> int:
             render_dashboard(
                 tsdoc, alerts, metrics, width=args.width,
                 source=f"jsonl:{args.from_jsonl}",
+                device=replay_device(args.from_jsonl),
             )
         )
         return 0
@@ -483,7 +578,8 @@ def main(argv=None) -> int:
                 continue
             print(
                 render_dashboard(
-                    tsdoc, alerts, metrics, width=args.width, source=base
+                    tsdoc, alerts, metrics, width=args.width, source=base,
+                    device=fetch_device(base),
                 )
             )
             if args.once:

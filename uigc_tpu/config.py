@@ -295,6 +295,22 @@ DEFAULTS: Dict[str, Any] = {
     # uigc_alerts_total{rule,severity} and serve on /alerts.  Only
     # meaningful with uigc.telemetry.timeseries on.
     "uigc.telemetry.alerts": True,
+    # --- Device-plane observatory (uigc_tpu/telemetry/device.py) ---
+    # Attach the device observatory: the per-family HBM/array memory
+    # ledger (uigc_device_ledger_bytes{family} + peak watermarks),
+    # compile-cache hit/miss telemetry with the recompile_storm alert,
+    # host-transfer accounting for the annotated readback sites, the
+    # donation audit, and per-sweep device-time attribution on the wake
+    # records.  Serves /device on the metrics HTTP server.  Implies the
+    # metrics registry and the wake profiler (attribution needs both).
+    "uigc.telemetry.device": False,
+    # Compile-cache miss rate (misses/s over the rule window) above
+    # which recompile_storm fires — a healthy steady state compiles
+    # each geometry once, so any sustained rate is a shape-key bug.
+    "uigc.telemetry.alert-recompile-rate": 0.2,
+    # Absolute device-seconds floor for the device_wake_regression rule
+    # (fires regardless of the learned EWMA baseline); 0 = EWMA-only.
+    "uigc.telemetry.alert-device-wake-threshold": 0.0,
     # EWMA-sigma deviation at which a regression rule fires.
     "uigc.telemetry.alert-ewma-sigma": 3.0,
     # Absolute wake-latency floor (seconds) that fires the wake rule

@@ -46,6 +46,47 @@ _PAIR_EDGE = pallas_incremental_kinds.EDGE
 _PAIR_SUP = pallas_incremental_kinds.SUP
 
 
+def _readback(value, site: str) -> np.ndarray:
+    """The sanctioned device->host crossing on collector paths:
+    materialize ``value`` on host and account the transfer as a
+    ``tpu.host_transfer`` event (site + bytes; the device observatory
+    attributes it to the active wake phase).  uigc-lint UL011 flags
+    unannotated ``np.asarray``/``.item()``/``device_get`` calls under
+    ``engines/`` and ``ops/`` — route readbacks through here."""
+    out = np.asarray(value)  # readback: the sanctioned crossing itself
+    if events.recorder.enabled:
+        events.recorder.commit(
+            events.HOST_TRANSFER, site=site, bytes=int(out.nbytes)
+        )
+    return out
+
+
+def audit_donation(site: str, *bufs) -> None:
+    """After a donating jitted call returns: every donated operand must
+    have been consumed (``is_deleted()`` true).  A survivor means XLA
+    silently copied instead of aliasing — the wake pays double HBM
+    traffic at that site every time — committed as ``tpu.donation_copy``
+    (the device observatory's donation-audit plane).  Host arrays
+    (numpy) handed to a donating call are the same bug by construction:
+    nothing can be donated, a device copy is forced."""
+    if not events.recorder.enabled:
+        return
+    for buf in bufs:
+        if buf is None:
+            continue
+        deleted = getattr(buf, "is_deleted", None)
+        try:
+            consumed = bool(deleted()) if deleted is not None else False
+        except Exception:
+            continue  # indeterminate (backend quirk): don't cry wolf
+        if not consumed:
+            events.recorder.commit(
+                events.DONATION_COPY,
+                site=site,
+                bytes=int(getattr(buf, "nbytes", 0) or 0),
+            )
+
+
 class ArrayShadowGraph:
     """Dense-slot shadow graph with kernel-backed tracing."""
 
@@ -94,6 +135,12 @@ class ArrayShadowGraph:
         #: never frees a slot the chain names.
         self.last_parents: Optional[np.ndarray] = None
         self.last_parents_mark: Optional[np.ndarray] = None
+        #: probe donated buffers after donating jitted calls and commit
+        #: ``tpu.donation_copy`` when one survived (see audit_donation).
+        #: Enabled by the device observatory's attach
+        #: (uigc_tpu/telemetry/Telemetry); off, the donating sites pay
+        #: one bool check.
+        self.donation_audit = False
         #: accumulated per-edge send matrix: packed (src << 32 | dst)
         #: slot key -> messages sent since enablement.  None (default)
         #: = off; the liveness inspector's attach enables it by
@@ -820,16 +867,24 @@ class ArrayShadowGraph:
         if self.use_device:
             with events.recorder.timed(events.DEVICE_TRACE) as ev:
                 if self.decremental:
-                    return self._compute_marks_decremental(ev)
+                    return _readback(
+                        self._compute_marks_decremental(ev),
+                        "marks.decremental",
+                    )
                 if self._on_tpu():
-                    return self._compute_marks_pallas(ev)
-                return trace_ops.trace_marks_jax(
-                    self.flags,
-                    self.recv_count,
-                    self.supervisor,
-                    self.edge_src,
-                    self.edge_dst,
-                    self.edge_weight,
+                    return _readback(
+                        self._compute_marks_pallas(ev), "marks.pallas"
+                    )
+                return _readback(
+                    trace_ops.trace_marks_jax(
+                        self.flags,
+                        self.recv_count,
+                        self.supervisor,
+                        self.edge_src,
+                        self.edge_dst,
+                        self.edge_weight,
+                    ),
+                    "marks.xla",
                 )
         # Host path: slice to the occupancy watermark.  Slots allocate
         # lowest-first (IntStack from_range), so live slots cluster low
@@ -877,6 +932,8 @@ class ArrayShadowGraph:
                     self.edge_dst,
                     self.edge_weight,
                 )
+                mark = _readback(mark, "marks.parents")
+                parent = _readback(parent, "parents.capture")
         else:
             mark, parent = trace_ops.trace_marks_np_parents(
                 self.flags,
@@ -886,9 +943,11 @@ class ArrayShadowGraph:
                 self.edge_dst,
                 self.edge_weight,
             )
-        self.last_parents = np.asarray(parent)
-        self.last_parents_mark = np.asarray(mark)
-        return np.asarray(mark)
+        # Both branches materialized host arrays above (the device one
+        # through the accounted _readback), so these are plain aliases.
+        self.last_parents = parent
+        self.last_parents_mark = mark
+        return mark
 
     def _on_tpu(self) -> bool:
         tpu = getattr(self, "_is_tpu", None)
@@ -986,7 +1045,8 @@ class ArrayShadowGraph:
                 self._stamp_sweep_stats(
                     ev,
                     None if ls is None else {
-                        k: np.asarray(v) for k, v in ls.items()
+                        k: np.asarray(v)  # readback: sweep-stat words
+                        for k, v in ls.items()
                     },
                 )
             return marks
@@ -1101,7 +1161,14 @@ class ArrayShadowGraph:
         with events.recorder.timed(events.TRACING) as ev:
             # unpack_marks auto-invalidates the tracer on readback
             # failure, so a poisoned wake needs no handling here.
-            mark = np.asarray(dec.unpack_marks(mark_w))
+            if getattr(dec, "accounts_readback", False):
+                # The handle already routed the crossing through
+                # _readback (the mesh wake handle does, under its
+                # collective lock) — accounting it again here would
+                # double-count every harvested wake's transfer bytes.
+                mark = np.asarray(dec.unpack_marks(mark_w))  # readback: accounted in the handle
+            else:
+                mark = _readback(dec.unpack_marks(mark_w), "marks.harvest")
             with events.recorder.timed(events.SWEEP):
                 garbage, kill = trace_ops.garbage_and_kills_np(
                     snap_flags, snap_sup, mark

@@ -376,6 +376,17 @@ class Bookkeeper(RawBehavior):
                 events.recorder.commit(
                     events.LISTENER_ERROR, listener="liveness_inspector"
                 )
+        obs = engine.device_observatory
+        if obs is not None:
+            # Device observatory: one read-only memory-ledger sample per
+            # wake, on the collector thread (fold-consistent, like the
+            # inspector's hook) and under the same isolation discipline.
+            try:
+                obs.on_wake(self.shadow_graph)
+            except Exception:
+                events.recorder.commit(
+                    events.LISTENER_ERROR, listener="device_observatory"
+                )
         self._after_wake(n_garbage)
         return count
 

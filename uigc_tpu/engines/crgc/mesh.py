@@ -41,7 +41,7 @@ from ...ops import trace as trace_ops
 from ...ops.slotmap import PackedSlotMap, fold_log, pack_keys, unpack_keys
 from ...parallel import sharded_trace
 from ...utils import events
-from .arrays import ArrayShadowGraph
+from .arrays import ArrayShadowGraph, _readback, audit_donation
 from .state import CrgcContext
 
 _SINK_PAD = 64  # scatter batches are padded to multiples of this
@@ -213,9 +213,32 @@ class MeshShadowGraph(ArrayShadowGraph):
         if fn is None:
             if len(_SHARED_PROGRAM_CACHE) >= _SHARED_PROGRAM_CACHE_MAX:
                 _SHARED_PROGRAM_CACHE.clear()
+            import time as _time
+
+            t0 = _time.perf_counter()
+            built = factory()
             # setdefault: a build race costs one discarded closure, never
             # a duplicate compile (compilation happens at first call).
-            fn = _SHARED_PROGRAM_CACHE.setdefault(key, factory())
+            fn = _SHARED_PROGRAM_CACHE.setdefault(key, built)
+            if events.recorder.enabled:
+                # Compile-cache plane (telemetry/device.py): a miss here
+                # means a NEW collective program geometry.  One miss per
+                # geometry is healthy; a per-wake miss stream for one
+                # (tag, geom) is the recompile_storm alert's input.
+                events.recorder.commit(
+                    events.COMPILE,
+                    duration_s=_time.perf_counter() - t0,
+                    tag=f"mesh.{tag}",
+                    geom=events.compile_geom(key),
+                    hit=False,
+                )
+        elif events.recorder.enabled:
+            events.recorder.commit(
+                events.COMPILE,
+                tag=f"mesh.{tag}",
+                geom=events.compile_geom(key),
+                hit=True,
+            )
         return fn
 
     # ------------------------------------------------------------- #
@@ -423,6 +446,19 @@ class MeshShadowGraph(ArrayShadowGraph):
         fn = self._jit_cache.get(name)
         if fn is None:
             fn = self._jit_cache[name] = builder()
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.COMPILE, tag=f"mesh.scatter.{name}",
+                    geom="graph", hit=False,
+                )
+        elif events.recorder.enabled:
+            # Hits commit like every instrumented cache, so the
+            # hit/miss shape stays 1-miss-then-hits — without this,
+            # N graphs' N innocent builds read as a storm downstream.
+            events.recorder.commit(
+                events.COMPILE, tag=f"mesh.scatter.{name}",
+                geom="graph", hit=True,
+            )
         return fn
 
     def _sync_jump_mirror(self) -> None:
@@ -456,9 +492,12 @@ class MeshShadowGraph(ArrayShadowGraph):
 
                 return apply_jump
 
+            donated = self._jump_dev
             self._jump_dev = self._jit("jump", build_jump)(
-                self._jump_dev, idx, vals
+                donated, idx, vals
             )
+            if self.donation_audit:
+                audit_donation("mesh.jump", donated)
 
     def _sync_device(self) -> None:
         if (
@@ -496,9 +535,12 @@ class MeshShadowGraph(ArrayShadowGraph):
 
                 return apply_pairs
 
+            donated_src, donated_dst = self._dev_psrc, self._dev_pdst
             self._dev_psrc, self._dev_pdst = self._jit("pairs", build_pairs)(
-                self._dev_psrc, self._dev_pdst, shs, cols, srcs, dsts
+                donated_src, donated_dst, shs, cols, srcs, dsts
             )
+            if self.donation_audit:
+                audit_donation("mesh.pairs", donated_src, donated_dst)
 
         if self._mask_writes:
             # base-layout deletions: per-shard in-place masking
@@ -557,9 +599,16 @@ class MeshShadowGraph(ArrayShadowGraph):
             fset[shard, col] = new_flags
             fclear[shard, col] = ~new_flags
             self._recv_synced[slots_arr] = new_recv
+            donated_flags, donated_recv = self._dev_flags, self._dev_recv
             self._dev_flags, self._dev_recv = self._fold_fn(
-                self._dev_flags, self._dev_recv, lslot, rdelta, fset, fclear
+                donated_flags, donated_recv, lslot, rdelta, fset, fclear
             )
+            if self.donation_audit:
+                # The sharded fold donates its node shards
+                # (sharded_trace.make_sharded_fold(donate=True)); a
+                # surviving input means every wake now re-uploads
+                # O(graph) node state instead of O(churn) deltas.
+                audit_donation("mesh.fold", donated_flags, donated_recv)
 
         self._sync_jump_mirror()
 
@@ -631,7 +680,7 @@ class MeshShadowGraph(ArrayShadowGraph):
                     self._dev_pdst,
                     *jump,
                 )
-                return np.asarray(mark)[: self.capacity]
+                return _readback(mark, "marks.mesh")[: self.capacity]
 
     def _dispatch_decremental_wake(self, meta) -> tuple:
         """Dispatch one closure+repair wake on the mesh (regional
@@ -712,6 +761,10 @@ class _MeshWakeHandle:
 
     __slots__ = ("graph", "n")
 
+    #: this handle's unpack_marks routes its device->host crossing
+    #: through _readback itself; the base harvest must not re-account it
+    accounts_readback = True
+
     def __init__(self, graph: "MeshShadowGraph"):
         self.graph = graph
         #: capacity at launch: the harvest sweeps against the LAUNCH
@@ -726,7 +779,7 @@ class _MeshWakeHandle:
             # process-wide mesh lock so it cannot interleave with
             # another graph's dispatch (see _MESH_COLLECTIVE_LOCK).
             with _MESH_COLLECTIVE_LOCK:
-                return np.asarray(mark_dev)[: self.n]
+                return _readback(mark_dev, "marks.mesh_harvest")[: self.n]
         except Exception:
             self.graph.invalidate_wake_state()
             raise

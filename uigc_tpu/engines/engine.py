@@ -92,6 +92,11 @@ class Engine:
         #: read-only callback per wake (flight recorder, leak watchdog)
         #: and consults ``parent_capture`` to gate why-live provenance.
         self.liveness_inspector: Optional[Any] = None
+        #: optional device observatory (uigc_tpu/telemetry/device.py),
+        #: installed by Telemetry.attach; the collector feeds it one
+        #: read-only ledger sample per wake (same isolation discipline
+        #: as the inspector).
+        self.device_observatory: Optional[Any] = None
 
     # -- Root-actor support ------------------------------------------- #
 

@@ -53,6 +53,7 @@ import numpy as np
 
 from . import pallas_trace as pt
 from . import trace as trace_ops
+from ..utils import events
 from ..utils.validation import require
 from .pallas_incremental import IncrementalPallasLayout
 
@@ -314,9 +315,25 @@ def get_wake_fn(n, specs, n_super, r_rows, s_rows, interpret=None,
     )
     fn = _fn_cache.get(key)
     if fn is None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         fn = _fn_cache[key] = _build_wake_fn(
             n, tuple(specs), n_super, r_rows, s_rows, interpret,
             mode=mode, pull_density=pull_density, with_stats=with_stats,
+        )
+        if events.recorder.enabled:
+            # Compile-cache plane (telemetry/device.py): one miss per
+            # geometry is healthy; a per-wake miss stream for one
+            # (tag, geom) is a shape-key bug (recompile_storm).
+            events.recorder.commit(
+                events.COMPILE, duration_s=_time.perf_counter() - t0,
+                tag="dec_wake", geom=events.compile_geom(key), hit=False,
+            )
+    elif events.recorder.enabled:
+        events.recorder.commit(
+            events.COMPILE, tag="dec_wake",
+            geom=events.compile_geom(key), hit=True,
         )
     return fn
 

@@ -62,6 +62,7 @@ from typing import Dict
 import numpy as np
 
 from . import trace as trace_ops
+from ..utils import events
 from ..utils.validation import require
 
 LANE = 128  # lanes per vreg row
@@ -318,6 +319,10 @@ def marking_parents_jax(flags, recv_count, supervisor, edge_src, edge_dst,
     oracle.  Shapes are static; the jitted fn is cached process-wide."""
     if "fn" not in _parents_fn_cache:
         _parents_fn_cache["fn"] = _build_parents_fn()
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.COMPILE, tag="parents_fn", geom="static", hit=False
+            )
     fn = _parents_fn_cache["fn"]
     mark, parent = fn(
         flags, recv_count, supervisor, edge_src, edge_dst, edge_weight
@@ -1445,11 +1450,26 @@ def get_trace_fn_multi(
     )
     fn = _fn_cache.get(key)
     if fn is None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         fn = _build_trace_fn_multi(
             n, tuple(specs), n_super, r_rows, s_rows, interpret,
             mode=mode, pull_density=pull_density, with_stats=with_stats,
         )
         _fn_cache[key] = fn
+        if events.recorder.enabled:
+            # Compile-cache plane (telemetry/device.py): per-wake misses
+            # of one (tag, geom) stream are the recompile_storm input.
+            events.recorder.commit(
+                events.COMPILE, duration_s=_time.perf_counter() - t0,
+                tag="trace_fn", geom=events.compile_geom(key), hit=False,
+            )
+    elif events.recorder.enabled:
+        events.recorder.commit(
+            events.COMPILE, tag="trace_fn",
+            geom=events.compile_geom(key), hit=True,
+        )
     return fn
 
 

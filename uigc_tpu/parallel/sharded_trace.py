@@ -26,7 +26,40 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from ..utils import events
 from ..utils.validation import require
+
+#: Process-wide cache of the small per-graph jitted helpers (the
+#: sharded fold/mask scatters): every MeshShadowGraph over the same
+#: device set shares ONE jit object instead of re-tracing its own —
+#: the same sharing discipline as mesh.py's _SHARED_PROGRAM_CACHE —
+#: and the compile-cache telemetry sees genuine 1-miss-then-hits
+#: streams instead of a miss per graph (which would read as a storm).
+#: Bounded by construction: one entry per (kind, device set, axis,
+#: donate) ever seen.
+_HELPER_CACHE: Dict[tuple, object] = {}
+
+
+def _cached_helper(kind: str, mesh, axis: str, extra: tuple, build):
+    key = (
+        kind,
+        tuple(d.id for d in mesh.devices.flat),
+        tuple(mesh.axis_names),
+        axis,
+        extra,
+    )
+    fn = _HELPER_CACHE.get(key)
+    hit = fn is not None
+    if not hit:
+        fn = _HELPER_CACHE[key] = build()
+    if events.recorder.enabled:
+        # Compile-cache plane (telemetry/device.py): one miss per
+        # geometry is healthy; per-wake misses are the storm signal.
+        events.recorder.commit(
+            events.COMPILE, tag=f"sharded_{kind}",
+            geom=events.compile_geom(key), hit=hit,
+        )
+    return fn
 
 
 def _jax():
@@ -578,18 +611,21 @@ def make_sharded_mask(mesh, axis: str = "gc"):
         em = em.at[r, c].set(0, mode="drop")
         return rp[None], em[None]
 
-    fn = shard_map(
-        local_mask,
-        mesh=mesh,
-        in_specs=(P(axis, None, None), P(axis, None, None), P(axis, None), P(axis, None)),
-        out_specs=(P(axis, None, None), P(axis, None, None)),
-    )
+    def build():
+        fn = shard_map(
+            local_mask,
+            mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None, None), P(axis, None), P(axis, None)),
+            out_specs=(P(axis, None, None), P(axis, None, None)),
+        )
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def mask(row_pos, emeta, ri, col):
-        return fn(row_pos, emeta, ri, col)
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def mask(row_pos, emeta, ri, col):
+            return fn(row_pos, emeta, ri, col)
 
-    return mask
+        return mask
+
+    return _cached_helper("mask", mesh, axis, (), build)
 
 
 def make_sharded_fold(mesh, axis: str = "gc", donate: bool = False):
@@ -630,19 +666,22 @@ def make_sharded_fold(mesh, axis: str = "gc", donate: bool = False):
         flags_pad = flags_pad.at[slot].set((old | flag_set) & (~flag_clear))
         return flags_pad[:size].reshape(1, -1), recv_pad[:size].reshape(1, -1)
 
-    fn = shard_map(
-        local_fold,
-        mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis, None), P(axis, None), P(axis, None), P(axis, None)),
-        out_specs=(P(axis, None), P(axis, None)),
-    )
+    def build():
+        fn = shard_map(
+            local_fold,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis, None), P(axis, None), P(axis, None), P(axis, None)),
+            out_specs=(P(axis, None), P(axis, None)),
+        )
 
-    @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
-    def fold(flags, recv, slot, recv_delta, flag_set, flag_clear):
-        f2, r2 = fn(flags, recv, slot, recv_delta, flag_set, flag_clear)
-        return f2.reshape(-1), r2.reshape(-1)
+        @partial(jax.jit, donate_argnums=(0, 1) if donate else ())
+        def fold(flags, recv, slot, recv_delta, flag_set, flag_clear):
+            f2, r2 = fn(flags, recv, slot, recv_delta, flag_set, flag_clear)
+            return f2.reshape(-1), r2.reshape(-1)
 
-    return fold
+        return fold
+
+    return _cached_helper("fold", mesh, axis, (donate,), build)
 
 
 def make_sharded_decremental_wake(

@@ -129,6 +129,7 @@ class ActorSystem:
             or self.config.get_bool("uigc.telemetry.wake-profile")
             or self.config.get_bool("uigc.telemetry.inspect")
             or self.config.get_bool("uigc.telemetry.timeseries")
+            or self.config.get_bool("uigc.telemetry.device")
             or self.config.get_int("uigc.telemetry.http-port") >= 0
             or bool(self.config.get_string("uigc.telemetry.jsonl-path"))
         ):

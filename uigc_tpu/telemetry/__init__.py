@@ -49,6 +49,7 @@ from .exporter import (
     replay_violations,
 )
 from .alerts import AlertEngine, AlertRule, builtin_rules
+from .device import DeviceObservatory, ledger_families, validate_device_doc
 from .inspect import FlightRecorder, LeakWatchdog, LivenessInspector
 from .metrics import EventMetricsBridge, MetricsRegistry, install_system_gauges
 from .profile import WakeProfiler
@@ -67,6 +68,9 @@ __all__ = [
     "LivenessInspector",
     "FlightRecorder",
     "LeakWatchdog",
+    "DeviceObservatory",
+    "ledger_families",
+    "validate_device_doc",
     "TimeSeriesStore",
     "MetricsSampler",
     "AlertEngine",
@@ -100,6 +104,7 @@ class Telemetry:
         )
         self.profiler: Optional[WakeProfiler] = None
         self.inspector: Optional[LivenessInspector] = None
+        self.observatory: Optional[DeviceObservatory] = None
         self.store: Optional[TimeSeriesStore] = None
         self.sampler: Optional[MetricsSampler] = None
         self.alerts: Optional[AlertEngine] = None
@@ -110,12 +115,21 @@ class Telemetry:
         self._ts_frames_registered = False
 
         timeseries_on = config.get_bool("uigc.telemetry.timeseries")
-        # The time plane samples the registry, so it implies metrics.
-        metrics_on = config.get_bool("uigc.telemetry.metrics") or timeseries_on
+        device_on = config.get_bool("uigc.telemetry.device")
+        # The time plane samples the registry, so it implies metrics;
+        # the device observatory exports through the registry too.
+        metrics_on = (
+            config.get_bool("uigc.telemetry.metrics")
+            or timeseries_on
+            or device_on
+        )
         profile_on = (
             config.get_bool("uigc.telemetry.wake-profile")
             # ... and feeds wake latency from the profiler's records.
             or timeseries_on
+            # The observatory attributes transfers to wake phases and
+            # per-sweep device time to wake records — both profiler-fed.
+            or device_on
         )
         inspect_on = config.get_bool("uigc.telemetry.inspect")
         http_port = config.get_int("uigc.telemetry.http-port")
@@ -141,6 +155,8 @@ class Telemetry:
                 engine.wake_profiler = self.profiler
         if inspect_on:
             self.inspector = self._attach_inspector()
+        if device_on:
+            self.observatory = self._attach_observatory()
         if timeseries_on:
             self._attach_timeseries()
         if jsonl_path:
@@ -158,6 +174,7 @@ class Telemetry:
                 node=system.address,
                 store=self.store,
                 alerts=self.alerts,
+                observatory=self.observatory,
             )
 
         if self._listeners or self.inspector is not None:
@@ -235,6 +252,35 @@ class Telemetry:
                 ),
             )
         return inspector
+
+    def _attach_observatory(self) -> Optional[DeviceObservatory]:
+        """Wire the device-plane observatory: a recorder listener (the
+        ``tpu.host_transfer`` / ``tpu.compile`` / ``tpu.donation_copy``
+        planes), the collector's per-wake ledger hook, and the engine-
+        side enablement flags — every mutation of engine state happens
+        HERE, the observatory itself only reads (the inspector's
+        discipline)."""
+        system = self.system
+        engine = getattr(system, "engine", None)
+        bookkeeper = getattr(engine, "bookkeeper", None)
+        graph_fn = None
+        if bookkeeper is not None:
+            graph_fn = lambda: bookkeeper.shadow_graph  # noqa: E731
+        observatory = DeviceObservatory(
+            node=system.address,
+            registry=self.registry,
+            profiler=self.profiler,
+            graph_fn=graph_fn,
+        )
+        self._listeners.append(observatory)
+        if engine is not None:
+            engine.device_observatory = observatory
+        # Donation audits cost an is_deleted() probe per donating call:
+        # enabled here, paid only while an observatory is attached.
+        graph = getattr(bookkeeper, "shadow_graph", None)
+        if graph is not None and hasattr(graph, "donation_audit"):
+            graph.donation_audit = True
+        return observatory
 
     def _attach_timeseries(self) -> None:
         """Wire the time plane: store + sampler thread, the anomaly/SLO
@@ -339,6 +385,17 @@ class Telemetry:
         engine = getattr(self.system, "engine", None)
         if engine is not None and engine.wake_profiler is self.profiler:
             engine.wake_profiler = None
+        if self.observatory is not None:
+            if engine is not None and (
+                engine.device_observatory is self.observatory
+            ):
+                engine.device_observatory = None
+            bookkeeper = getattr(engine, "bookkeeper", None)
+            graph = getattr(bookkeeper, "shadow_graph", None)
+            if graph is not None and getattr(graph, "donation_audit", False):
+                graph.donation_audit = False
+            self.observatory.close()
+            self.observatory = None
         if self.inspector is not None:
             if self.inspector.dump_path:
                 self.inspector.on_crash(reason="close")

@@ -317,7 +317,50 @@ def builtin_rules(config: Any) -> List[AlertRule]:
     gap_rate = config.get_float("uigc.telemetry.alert-gap-rate")
     queue_limit = config.get_int("uigc.node.writer-queue-limit")
     phi_threshold = config.get_float("uigc.node.phi-threshold")
+    recompile_rate = config.get_float("uigc.telemetry.alert-recompile-rate")
+    device_floor = config.get_float(
+        "uigc.telemetry.alert-device-wake-threshold"
+    )
     return [
+        # -- device-plane rules (uigc_tpu/telemetry/device.py feeds the
+        # series; they never evaluate when the observatory is off) ----- #
+        AlertRule(
+            "recompile_storm",
+            "uigc_compile_misses_total",
+            "rate",
+            severity="critical",
+            op=">",
+            value=recompile_rate,
+            window_s=30.0,
+            description="a compile cache is being missed repeatedly "
+            "(shape-key churn): every wake pays a fresh XLA compile — "
+            "the PR 5 multi-system pjit hang was this class of bug",
+        ),
+        AlertRule(
+            "device_wake_regression",
+            "uigc_wake_device_seconds",
+            "ewma",
+            severity="warning",
+            sigma=sigma,
+            value=device_floor,
+            window_s=60.0,
+            agg="mean",
+            description="the device-kernel share of a collector wake "
+            "regressed beyond the learned baseline (or the configured "
+            "floor); run device_report for the sweep-by-sweep picture",
+        ),
+        AlertRule(
+            "donation_copy_detected",
+            "uigc_donation_copies_total",
+            "rate",
+            severity="warning",
+            op=">",
+            value=0.0,
+            window_s=120.0,
+            description="a supposedly-donated device buffer survived "
+            "its donating call (XLA silently copied): per-wake HBM "
+            "traffic doubled at that site",
+        ),
         AlertRule(
             "wake_latency_regression",
             "uigc_wake_wall_seconds",

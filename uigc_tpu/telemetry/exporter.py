@@ -92,18 +92,23 @@ class MetricsHTTPServer:
     ``/timeseries`` (``?name=``/``?window=``/``?resolution=`` select a
     series and range, ``?merged=1`` pulls and merges the cluster's
     stores over the ``tsq``/``tsr`` frames) and ``/alerts`` (the
-    anomaly/SLO engine's firing set and rule catalog).
+    anomaly/SLO engine's firing set and rule catalog); with the device
+    observatory attached (``uigc.telemetry.device``), also ``/device``
+    (the memory-ledger/compile-cache/transfer document
+    ``tools/device_report.py`` renders).
     ``port=0`` binds an ephemeral port; read the bound one from
     :attr:`port`."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  host: str = "127.0.0.1", inspector: Any = None,
-                 node: str = "", store: Any = None, alerts: Any = None):
+                 node: str = "", store: Any = None, alerts: Any = None,
+                 observatory: Any = None):
         self.registry = registry
         self.inspector = inspector
         self.node = node
         self.store = store
         self.alerts = alerts
+        self.observatory = observatory
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -126,6 +131,15 @@ class MetricsHTTPServer:
                     try:
                         body = json.dumps(
                             outer._timeseries_doc(query), default=repr
+                        )
+                    except Exception as exc:
+                        self._send_json_error(500, repr(exc))
+                        return
+                    ctype = "application/json"
+                elif route.startswith("/device") and outer.observatory is not None:
+                    try:
+                        body = json.dumps(
+                            outer.observatory.to_doc(), default=repr
                         )
                     except Exception as exc:
                         self._send_json_error(500, repr(exc))

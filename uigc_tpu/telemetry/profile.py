@@ -168,6 +168,21 @@ class WakeProfiler:
             **wake.trace_fields,
             **fields,
         }
+        if record.get("n_sweeps") and wake.device_s > 0.0:
+            # Per-sweep device attribution (uigc_tpu/telemetry/device.py):
+            # the wake's measured device seconds distributed over its
+            # sweeps by dirty-chunk weight.  Sums back to device_s by
+            # construction, so downstream reports always reconcile with
+            # this profiler's own device figure.
+            from .device import sweep_attribution
+
+            ms, bytes_est = sweep_attribution(
+                wake.device_s,
+                int(record["n_sweeps"]),
+                record.get("sweep_dirty_chunks"),
+            )
+            record["sweep_device_ms"] = ms
+            record["sweep_bytes_est"] = bytes_est
         if self._phase_hist is not None:
             for name in PHASES:
                 self._phase_hist.observe(phases[name], phase=name)

@@ -41,6 +41,34 @@ DEVICE_TRACE = "tpu.device_trace"  # ours: one device kernel dispatch
 #: so the wake profiler (uigc_tpu/telemetry/profile.py) can attribute
 #: trace-vs-sweep time without backend-specific hooks.
 SWEEP = "crgc.sweep"
+# Device-plane observatory events (ours; uigc_tpu/telemetry/device.py
+# folds them into the HBM ledger / compile-cache / transfer planes):
+#   tpu.host_transfer   a device->host value crossing on a collector
+#                       path (fields: site, bytes) — committed by the
+#                       annotated readback sites in engines/crgc and
+#                       attributed to the active wake's profiler phase
+#   tpu.donation_copy   a buffer handed to a donating jitted call
+#                       SURVIVED the call (is_deleted() false): XLA
+#                       silently copied instead of aliasing (fields:
+#                       site, bytes) — the donation-audit signal
+#   tpu.compile         a compile-cache consultation (fields: tag,
+#                       geom, hit; duration_s on a miss when the build
+#                       was timed) — recompile storms are a rate spike
+#                       of hit=False commits for one (tag, geom) stream
+HOST_TRANSFER = "tpu.host_transfer"
+DONATION_COPY = "tpu.donation_copy"
+COMPILE = "tpu.compile"
+
+
+def compile_geom(key: Any) -> str:
+    """Short stable label of a compile-cache geometry key (crc32 of its
+    repr) for ``tpu.compile`` events: process-stable, bounded label
+    cardinality, and two sites caching on the same key tuple agree on
+    the label — which is what lets a recompile storm show up as ONE
+    (tag, geom) stream missing repeatedly rather than scattered noise."""
+    import zlib
+
+    return format(zlib.crc32(repr(key).encode()) & 0xFFFFFFFF, "08x")
 
 # Transport/failure events (ours; the reference has no failure-injection
 # instrumentation).  Emitted by runtime/node.py, runtime/fabric.py,
