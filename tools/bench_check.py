@@ -84,6 +84,20 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
             Metric("sweeps_mean", "lower", 0.40),
         ],
     ),
+    # Serving scenarios (tools/serving_bench.py): the chat-session
+    # fleet through a rolling restart.  lost_acked is a hard zero —
+    # a single acked command lost across drain/restart/die is a
+    # durability regression, not jitter; restart p99 gets a wide band
+    # (it includes rejoin rebalances on whatever host ran the round).
+    "SCENARIO": (
+        "BENCH_SCENARIO_r*.json",
+        [
+            Metric("steady.messages_per_sec", "higher", 0.40),
+            Metric("restart.p99_latency_s", "lower", 0.60),
+            Metric("recovery.seconds_per_entity", "lower", 0.60),
+            Metric("ledger.lost_acked", "zero", 0.0),
+        ],
+    ),
     # Device plane (telemetry/device.py + tools/device_report.py): the
     # TPU-session artifacts gate the same figures the wake-budget
     # explainer decomposes.  Rounds that predate wake_chain_bench (or

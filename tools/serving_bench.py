@@ -1,0 +1,399 @@
+"""Serving bench: a chat-session fleet through a rolling restart.
+
+The scenario ROADMAP item 4 names: N chat-session entities (sharded,
+journaled) served by a 3-node cluster under sustained acked traffic,
+while the cluster is rolled node by node — drain, terminate, restart,
+rejoin — and finally one node is killed abruptly (``NodeFabric.die``).
+The client keeps a ledger of every ACKED command; the run fails unless
+the final per-session counts cover every acked command (journal replay
+verified against the ledger: zero acknowledged state lost).
+
+Phases and the figures they print:
+
+1. **steady**   — sustained ``say`` traffic with per-message acks:
+   messages/sec plus ack-latency p50/p99;
+2. **restart**  — every data node drained + restarted in sequence with
+   traffic still running: p99 ack latency THROUGH the restart window,
+   per-node drain + rejoin wall time;
+3. **crash**    — one node killed abruptly; survivors journal-recover
+   its sessions: recovery seconds and seconds-per-entity;
+4. **ledger**   — per-session floor check: ``lost_acked`` must be 0.
+
+Prints one JSON object; commit as ``BENCH_SCENARIO_r{N}.json``
+(bench_check's SCENARIO family gates messages_per_sec, restart p99 and
+lost_acked across rounds).
+
+Usage: python tools/serving_bench.py [--sessions 300] [--seconds 4] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from uigc_tpu import ActorSystem, ClusterSharding, Entity  # noqa: E402
+from uigc_tpu.runtime.behaviors import RawBehavior  # noqa: E402
+from uigc_tpu.runtime.node import NodeFabric  # noqa: E402
+from uigc_tpu.utils import events  # noqa: E402
+from uigc_tpu.utils.validation import require  # noqa: E402
+
+
+def base_config(journal_dir: str) -> dict:
+    return {
+        "uigc.crgc.wakeup-interval": 50,
+        "uigc.crgc.egress-finalize-interval": 10,
+        "uigc.crgc.shadow-graph": "array",
+        "uigc.crgc.num-nodes": 3,
+        "uigc.cluster.tick-interval": 40,
+        "uigc.cluster.handoff-retry": 150,
+        # Slack for loaded hosts: an expired hold lets on-demand
+        # recovery race an in-flight migration (the lost-ack class the
+        # ledger would catch); the timeout is only a wedge safety valve.
+        "uigc.cluster.hold-timeout": 15000,
+        # The durability plane under test:
+        "uigc.cluster.journal-dir": journal_dir,
+        "uigc.cluster.journal-fsync": "interval",
+        "uigc.cluster.journal-snapshot-every": 32,
+        # Bounded end-to-end: entity mailboxes block (propagating to
+        # writer queues), cluster buffers shed-with-accounting.
+        "uigc.cluster.entity-mailbox-limit": 4096,
+        "uigc.runtime.overflow-policy": "block",
+        "uigc.runtime.throughput": 256,
+        "uigc.node.max-batch-frames": 1024,
+        "uigc.node.writer-queue-limit": 32768,
+    }
+
+
+class ChatSession(Entity):
+    """One conversation: an append-only transcript tail + count."""
+
+    def __init__(self, ctx, key, state):
+        super().__init__(ctx, key)
+        state = state or {}
+        self.count = state.get("count", 0)
+        self.tail = state.get("tail", [])
+
+    def receive(self, msg):
+        kind = msg[0]
+        if kind == "say":
+            # ("say", text, t_sent, reply_cell)
+            self.count += 1
+            self.tail.append(msg[1])
+            if len(self.tail) > 8:
+                del self.tail[0]
+            msg[3].tell(("ack", self.key, self.count, msg[2]))
+        elif kind == "probe":
+            msg[1].tell(("hist", self.key, self.count))
+        return self
+
+    def snapshot_state(self):
+        return {"count": self.count, "tail": list(self.tail)}
+
+
+def session_factory(ctx, key, state):
+    return ChatSession(ctx, key, state)
+
+
+class Ledger(RawBehavior):
+    """Client-side truth: per-session highwater of ACKED counts, plus
+    ack latency samples."""
+
+    def __init__(self):
+        self.acked = {}
+        self.hist = {}
+        self.latencies = []
+        self._lock = threading.Lock()
+
+    def on_message(self, msg):
+        if not isinstance(msg, tuple) or not msg:
+            return None
+        if msg[0] == "ack":
+            _kind, key, count, t_sent = msg
+            now = time.perf_counter()
+            with self._lock:
+                if count > self.acked.get(key, 0):
+                    self.acked[key] = count
+                self.latencies.append(now - t_sent)
+        elif msg[0] == "hist":
+            with self._lock:
+                self.hist[msg[1]] = msg[2]
+        return None
+
+    def ack_total(self):
+        with self._lock:
+            return sum(self.acked.values())
+
+    def take_latencies(self):
+        with self._lock:
+            out = self.latencies
+            self.latencies = []
+            return out
+
+
+def percentile(samples, p):
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1, int(round(p / 100.0 * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+class Node:
+    __slots__ = ("name", "fabric", "system", "cluster", "region", "port")
+
+    def __init__(self, name: str, config: dict):
+        self.name = name
+        self.fabric = NodeFabric()
+        self.system = ActorSystem(None, name=name, config=config, fabric=self.fabric)
+        self.port = self.fabric.listen()
+        self.cluster = ClusterSharding.attach(self.system)
+        self.region = self.cluster.start("chat", session_factory)
+
+
+def settle(predicate, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+def run(n_sessions: int, phase_seconds: float) -> dict:
+    journal_dir = tempfile.mkdtemp(prefix="uigc-serving-journal-")
+    recovered = []
+
+    def listener(name, fields):
+        if name == events.JOURNAL_RECOVERED:
+            recovered.append(fields)
+
+    config = base_config(journal_dir)
+    nodes = {
+        name: Node(name, config) for name in ("serve-a", "serve-b", "serve-c")
+    }
+    a = nodes["serve-a"]
+    result = {"sessions": n_sessions, "journal_dir": journal_dir}
+    stop = threading.Event()
+    sent_total = [0]
+    keys = [f"session-{i}" for i in range(n_sessions)]
+
+    ledger = Ledger()
+    ledger_cell = a.system.spawn_system_raw(ledger, "ledger")
+
+    def frontend():
+        # One ingress frontend on node a drives the whole keyspace —
+        # every message exercises routing, ~2/3 cross a link.
+        i = 0
+        cluster = a.cluster
+        while not stop.is_set():
+            key = keys[i % n_sessions]
+            cluster.entity_ref("chat", key).tell(
+                ("say", f"m{i}", time.perf_counter(), ledger_cell)
+            )
+            sent_total[0] += 1
+            i += 1
+            if i % 64 == 0:
+                time.sleep(0.001)  # breathe: let acks drain
+
+    try:
+        for other in ("serve-b", "serve-c"):
+            a.fabric.connect("127.0.0.1", nodes[other].port)
+        nodes["serve-b"].fabric.connect("127.0.0.1", nodes["serve-c"].port)
+        require(
+            settle(lambda: all(len(n.cluster.members()) == 3 for n in nodes.values())),
+            "bench.membership",
+            "3-node membership never settled",
+        )
+        for key in keys:
+            a.cluster.entity_ref("chat", key).tell(
+                ("say", "warm", time.perf_counter(), ledger_cell)
+            )
+        require(
+            settle(
+                lambda: sum(n.region.active_count() for n in nodes.values())
+                == n_sessions
+            ),
+            "bench.warmup",
+            "keyspace never fully activated",
+        )
+
+        # -- phase 1: steady state ---------------------------------- #
+        thread = threading.Thread(target=frontend, daemon=True)
+        ledger.take_latencies()
+        t0 = time.perf_counter()
+        thread.start()
+        time.sleep(phase_seconds)
+        steady_sent = sent_total[0]
+        steady_s = time.perf_counter() - t0
+        lat = ledger.take_latencies()
+        result["steady"] = {
+            "seconds": steady_s,
+            "messages": steady_sent,
+            "messages_per_sec": steady_sent / steady_s,
+            "ack_p50_ms": percentile(lat, 50) * 1e3,
+            "ack_p99_ms": percentile(lat, 99) * 1e3,
+            "ack_samples": len(lat),
+        }
+
+        # -- phase 2: rolling restart under traffic ----------------- #
+        events.recorder.enable()
+        events.recorder.add_listener(listener)
+        restart_stats = []
+        window_lat = []
+        for name in ("serve-b", "serve-c"):
+            node = nodes[name]
+            t_drain = time.perf_counter()
+            drained = node.fabric.drain(timeout_s=30.0)
+            drain_s = time.perf_counter() - t_drain
+            node.system.terminate(timeout_s=10.0)
+            require(
+                settle(
+                    lambda: node.system.address not in a.cluster.members(),
+                    30.0,
+                ),
+                "bench.depart",
+                f"{name} never left the member set",
+            )
+            t_join = time.perf_counter()
+            fresh = Node(name, config)
+            nodes[name] = fresh
+            fresh.fabric.connect("127.0.0.1", a.port)
+            for other_name, other in nodes.items():
+                if other_name not in (name, "serve-a"):
+                    fresh.fabric.connect("127.0.0.1", other.port)
+            require(
+                settle(
+                    lambda: len(fresh.cluster.members()) == 3
+                    and fresh.region.active_count() > 0
+                    and all(
+                        n.cluster.migrations.pending_count() == 0
+                        for n in nodes.values()
+                    ),
+                    60.0,
+                ),
+                "bench.rejoin",
+                f"{name} never rejoined/rebalanced",
+            )
+            join_s = time.perf_counter() - t_join
+            restart_stats.append(
+                {"node": name, "drained": drained, "drain_s": drain_s, "rejoin_s": join_s}
+            )
+            window_lat.extend(ledger.take_latencies())
+        result["restart"] = {
+            "nodes_rolled": len(restart_stats),
+            "per_node": restart_stats,
+            "drain_s_mean": sum(r["drain_s"] for r in restart_stats)
+            / len(restart_stats),
+            "rejoin_s_mean": sum(r["rejoin_s"] for r in restart_stats)
+            / len(restart_stats),
+            "p99_latency_s": percentile(window_lat, 99),
+            "p50_latency_s": percentile(window_lat, 50),
+            "ack_samples": len(window_lat),
+        }
+
+        # -- phase 3: abrupt kill + journal recovery ---------------- #
+        victim = nodes["serve-c"]
+        doomed = sum(
+            1 for k in keys if a.cluster.home_of(k) == victim.system.address
+        )
+        t_crash = time.perf_counter()
+        victim.fabric.die()
+        require(
+            settle(
+                lambda: victim.system.address not in a.cluster.members(), 30.0
+            ),
+            "bench.death",
+            "victim never declared dead",
+        )
+        require(
+            settle(lambda: len(recovered) >= doomed, 60.0),
+            "bench.recovery",
+            "journal recovery never covered the victim's sessions",
+            recovered=len(recovered),
+            doomed=doomed,
+        )
+        recovery_s = time.perf_counter() - t_crash
+        stop.set()
+        thread.join(timeout=5)
+        result["recovery"] = {
+            "entities": len(recovered),
+            "seconds": recovery_s,
+            "seconds_per_entity": recovery_s / max(1, len(recovered)),
+            "replay_s_mean": (
+                sum(f.get("duration_s") or 0.0 for f in recovered)
+                / max(1, len(recovered))
+            ),
+        }
+
+        # -- phase 4: ledger verification --------------------------- #
+        survivors = [n for n in nodes.values() if n is not victim]
+        deadline = time.monotonic() + 60.0
+        lost = keys
+        while time.monotonic() < deadline:
+            with ledger._lock:
+                lost = [
+                    k
+                    for k in keys
+                    if ledger.hist.get(k, -1) < ledger.acked.get(k, 0)
+                ]
+            if not lost:
+                break
+            for k in lost:
+                a.cluster.entity_ref("chat", k).tell(("probe", ledger_cell))
+            time.sleep(0.3)
+        result["ledger"] = {
+            "acked_commands": ledger.ack_total(),
+            "sessions_verified": n_sessions - len(lost),
+            "lost_acked": len(lost),
+        }
+        require(
+            not lost,
+            "bench.ledger",
+            "acked state lost across the rolling restart",
+            lost=lost[:5],
+            n=len(lost),
+        )
+        result["journal"] = {
+            node.name: node.cluster.journal.stats() for node in survivors
+        }
+    finally:
+        stop.set()
+        events.recorder.remove_listener(listener)
+        events.recorder.disable()
+        for node in nodes.values():
+            try:
+                node.system.terminate(timeout_s=5.0)
+            except Exception:
+                pass
+        shutil.rmtree(journal_dir, ignore_errors=True)
+        result.pop("journal_dir", None)
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--sessions", type=int, default=300)
+    parser.add_argument(
+        "--seconds", type=float, default=4.0, help="steady-phase duration"
+    )
+    parser.add_argument(
+        "--smoke", action="store_true", help="quick gate (60 sessions, 1s)"
+    )
+    args = parser.parse_args()
+    if args.smoke:
+        args.sessions, args.seconds = 60, 1.0
+    result = run(args.sessions, args.seconds)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

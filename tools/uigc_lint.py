@@ -159,7 +159,19 @@ RULES = {
     "UL009": "metric name violates the uigc_ prefix / unit-suffix convention",
     "UL010": "direct pickle call on a runtime hot-path module outside wire.py",
     "UL011": "unannotated device->host transfer on an engines/ops hot path",
+    "UL012": "unbounded queue-shaped attribute in runtime//cluster/ "
+    "without a bound or an '# unbounded:' rationale",
 }
+
+#: UL012: attribute names that read as queues/buffers.  The rule fires
+#: on ``self.<attr> = deque()`` (no maxlen), ``= []`` or ``= list()``
+#: in runtime//cluster/ files: every queue there must either carry a
+#: real bound (deque maxlen, admission checks) or an explicit
+#: ``# unbounded: <why>`` annotation on the line — the silent-growth
+#: class PR 12's backpressure plane exists to eliminate.
+_QUEUE_ATTR = re.compile(
+    r"(queue|buf|pending|deferred|backlog|outq|box|_q$)", re.IGNORECASE
+)
 
 #: UL011: module qualifiers numpy is imported under in this repo.
 _NUMPY_QUALS = {"np", "numpy", "_np"}
@@ -311,6 +323,12 @@ class _FileLinter:
             for i, line in enumerate(source.splitlines())
             if "# readback:" in line
         }
+        #: lines carrying an "# unbounded:" rationale (UL012 exemption)
+        self._unbounded_lines = {
+            i + 1
+            for i, line in enumerate(source.splitlines())
+            if "# unbounded:" in line
+        }
 
     def add(self, line: int, rule: str, message: str) -> None:
         codes = self._suppressed.get(line, ())
@@ -326,6 +344,7 @@ class _FileLinter:
         norm = self.path.replace(os.sep, "/")
         pickle_guarded = in_runtime and not norm.endswith("runtime/wire.py")
         device_plane = bool({"engines", "ops", "parallel"} & set(parts))
+        bounded_plane = in_runtime or "cluster" in parts
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
@@ -339,6 +358,8 @@ class _FileLinter:
                 self._lint_metric_name(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)) and bounded_plane:
+                self._lint_unbounded_queue(node)
         if self.path.replace(os.sep, "/").endswith("telemetry/inspect.py"):
             self._lint_inspect_readonly()
         if lint_asserts:
@@ -558,6 +579,48 @@ class _FileLinter:
                 "route through arrays._readback or annotate the line "
                 "with '# readback: <why>'",
             )
+
+    def _lint_unbounded_queue(self, node: ast.AST) -> None:
+        """UL012: queue-shaped attributes in runtime//cluster/ must be
+        bounded or carry an explicit '# unbounded: <why>' rationale —
+        the silent-deque-growth class the durability/backpressure plane
+        (PR 12) exists to eliminate.  Heuristic by construction: only
+        ``self.<queueish> = deque() | [] | list()`` assignments fire."""
+        if node.lineno in self._unbounded_lines:
+            return
+        value = node.value
+        if value is None:
+            return
+        unbounded = False
+        if isinstance(value, ast.Call):
+            name = _call_name(value)[1]
+            if name == "deque":
+                has_maxlen = any(kw.arg == "maxlen" for kw in value.keywords)
+                if not has_maxlen and len(value.args) < 2:
+                    unbounded = True
+            elif name == "list" and not value.args:
+                unbounded = True
+        elif isinstance(value, ast.List) and not value.elts:
+            unbounded = True
+        if not unbounded:
+            return
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for target in targets:
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+                and _QUEUE_ATTR.search(target.attr)
+            ):
+                self.add(
+                    node.lineno,
+                    "UL012",
+                    f"queue-shaped attribute self.{target.attr} is an "
+                    "unbounded deque()/list; bound it (maxlen / admission "
+                    "check) or annotate the line with '# unbounded: <why>'",
+                )
 
     def _lint_pickle_hot_path(self, call: ast.Call) -> None:
         """UL010: pickle stays behind the wire.py fallback on runtime

@@ -291,10 +291,17 @@ class Sanitizer:
         """Observe the collector's control-plane stream for the monotone
         sequence invariant on peer delta gossip."""
         from ..engines.crgc.collector import DeltaMsg
+        from ..runtime.fabric import MemberRemoved
 
         orig = bookkeeper.on_message
 
         def on_message(msg: Any) -> Any:
+            if isinstance(msg, MemberRemoved):
+                # A rejoining FRESH incarnation of this address starts
+                # its gossip sequence from zero — the monotonicity
+                # window is per incarnation, not per address.
+                with self._lock:
+                    self._delta_seq.pop(msg.address, None)
             if isinstance(msg, DeltaMsg) and msg.graph.address is not None:
                 addr = msg.graph.address
                 with self._lock:

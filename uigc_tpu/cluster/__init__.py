@@ -10,6 +10,7 @@ migrate live entities with their state; idle entities passivate to an
 in-memory store and recreate on the next send.
 """
 
+from .journal import EntityJournal
 from .migration import MigrationManager, translate_refs
 from .passivation import PassivationPolicy, StateStore
 from .sharding import (
@@ -25,6 +26,7 @@ from .sharding import (
 __all__ = [
     "ClusterSharding",
     "Entity",
+    "EntityJournal",
     "EntityRef",
     "MigrationManager",
     "PassivationPolicy",

@@ -215,7 +215,7 @@ class MigrationManager:
             if snapshot is None:
                 return False  # already gone (delivered or shipped)
             region._entities[key] = _EntityRecord(None, _HANDOFF)
-            region._buffers.setdefault(key, [])
+            region._buffers.setdefault(key, deque())
         self._captured(region, key, snapshot, [])
         return True
 
@@ -228,6 +228,22 @@ class MigrationManager:
     ) -> None:
         """Entity-thread completion of the capture: encode once, then
         ship (and keep for retries)."""
+        if region.cluster.journal is not None:
+            # Journal checkpoint at the handoff boundary: the captured
+            # snapshot (plus the drained-but-unprocessed pending tail)
+            # becomes the key's newest epoch, so a crash anywhere
+            # between capture and ack leaves the state recoverable by
+            # whoever inherits the shard.  Safe without the region
+            # lock: the key is mid-HANDOFF, so no concurrent delivery
+            # can interleave commands for it.
+            try:
+                region._journal_open(key, snapshot)
+                for payload in pending:
+                    region._journal_command(key, payload)
+            except Exception:  # durability must not abort the handoff
+                import traceback
+
+                traceback.print_exc()
         blob = wire.encode_message((snapshot, pending))
         mig = _Migration(
             region, key, (self.cluster.address, next(self._seq)), blob
@@ -300,6 +316,18 @@ class MigrationManager:
         # re-routes — the table now names the new home, so stragglers
         # forward instead of dead-lettering.
         buffered = mig.region._finish_transition(key)
+        journal = self.cluster.journal
+        if journal is not None:
+            # The key left this node: stop tracking it — UNLESS the
+            # handoff bounced home (the record re-activated locally
+            # before this self-ack landed), where the live epoch must
+            # keep numbering forward.  Check + forget under the region
+            # lock as ONE step: a re-activation racing between them
+            # would have its fresh epoch tracking erased (every
+            # activation path opens the epoch under this same lock).
+            with mig.region._lock:
+                if mig.region._entities.get(key) is None:
+                    journal.forget(type_name, key)
         for payload in buffered:
             self.cluster.route(type_name, key, payload)
         # Grant bookkeeping: this may have been the shard's last key.

@@ -23,7 +23,7 @@ passivation can never lose traffic, only waste a spill.
 from __future__ import annotations
 
 import threading
-from typing import TYPE_CHECKING, Any, Dict, List
+from typing import TYPE_CHECKING, Any, Dict, List, Optional
 
 from ..utils import events
 from .sharding import _ACTIVE, _EntityCtl, _PASSIVATING
@@ -33,18 +33,29 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 class StateStore:
-    """In-memory snapshot store for passivated entities (key -> state).
-    Deliberately a trivial dict behind a lock: the spill format is the
-    entity's own picklable snapshot, so swapping this for a persistent
-    backend is a two-method exercise."""
+    """Snapshot store for passivated entities (key -> state).
 
-    def __init__(self) -> None:
+    The in-memory dict is the fast path; with a ``spill`` callback
+    attached (the region wires it to the entity journal,
+    cluster/journal.py) every put ALSO lands a durable snapshot record
+    — the durable backend that lets a node holding only passivated
+    entities die and have whoever inherits its shards recover them."""
+
+    def __init__(self, spill: Optional[Any] = None) -> None:
         self._lock = threading.Lock()
         self._states: Dict[str, Any] = {}
+        self._spill = spill
 
     def put(self, key: str, state: Any) -> None:
         with self._lock:
             self._states[key] = state
+        if self._spill is not None:
+            try:
+                self._spill(key, state)
+            except Exception:  # durability must not abort the spill
+                import traceback
+
+                traceback.print_exc()
 
     def pop(self, key: str) -> Any:
         with self._lock:
@@ -123,7 +134,7 @@ def passivate_captured(region: "ShardRegion", key: str, snapshot: Any,
             events.recorder.commit(
                 events.SHARD_ENTITY_PASSIVATED, key=key, type=region.type_name
             )
-        leftover = pending + buffered
+        leftover = list(pending) + list(buffered)
         if leftover:
             # The spill was wasted: new messages arrived mid-capture.
             # Pull the state straight back out and rebuild the entity.

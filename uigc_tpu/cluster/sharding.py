@@ -48,9 +48,11 @@ import itertools
 import re
 import threading
 import time
+from collections import deque
 from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional, Tuple
 
 from ..runtime.behaviors import AbstractBehavior, ActorFactory, RawBehavior
+from ..runtime.cell import MailboxOverflowError
 from ..runtime.fabric import MemberRemoved, MemberUp
 from ..runtime import wire
 from ..utils import events
@@ -157,6 +159,11 @@ class _EntityCtl:
 
     __slots__ = ()
 
+    #: bounded mailboxes must never shed a control command — a lost
+    #: capture wedges its key's transition forever (cell.py honors
+    #: this in its shed-oldest path)
+    uigc_unsheddable = True
+
     def apply(self, entity: "Entity") -> Any:
         raise NotImplementedError
 
@@ -198,6 +205,39 @@ class Entity(AbstractBehavior):
 EntityFactory = Callable[[Any, str, Any], Entity]
 
 
+class _JournalSnapCmd(_EntityCtl):
+    """Periodic journal snapshot: capture ``snapshot_state()`` on the
+    entity's own thread and commit it as the base record of the epoch
+    the region already bumped (cluster/journal.py).  The entity keeps
+    running — unlike the migration/passivation captures this is not a
+    transition, just a durability checkpoint."""
+
+    __slots__ = ("region", "key", "epoch")
+
+    def __init__(self, region: "ShardRegion", key: str, epoch: int):
+        self.region = region
+        self.key = key
+        self.epoch = epoch
+
+    def apply(self, entity: "Entity") -> Any:
+        journal = self.region.cluster.journal
+        if journal is None:
+            return None
+        try:
+            state = entity.snapshot_state()
+            blob = wire.encode_message(state) if state is not None else None
+        except Exception:  # a failing snapshot must not kill the entity
+            import traceback
+
+            traceback.print_exc()
+            return None
+        shard = self.region.cluster.shard_of_key(self.key)
+        journal.commit_snapshot(
+            self.region.type_name, shard, self.key, self.epoch, blob
+        )
+        return None
+
+
 class EntityRef:
     """Location-transparent handle for a sharded entity.
 
@@ -217,7 +257,10 @@ class EntityRef:
         self.key = key
 
     def tell(self, msg: Any) -> None:
-        self._cluster.route(self.type_name, self.key, msg)
+        # raise_overflow: a LOCAL sender under the "error" overflow
+        # policy gets the MailboxOverflowError; re-routes and remote
+        # deliveries degrade to shed-oldest instead (route()).
+        self._cluster.route(self.type_name, self.key, msg, raise_overflow=True)
 
     def __eq__(self, other: Any) -> bool:
         return (
@@ -267,9 +310,15 @@ class ShardRegion:
         self.factory = factory
         self._lock = threading.RLock()
         self._entities: Dict[str, _EntityRecord] = {}
-        #: messages parked while their key is mid-handoff/passivation
-        self._buffers: Dict[str, List[Any]] = {}
-        self.store = StateStore()
+        #: messages parked while their key is mid-handoff/passivation;
+        #: each per-key deque is capped at cluster.buffer_limit (shed-
+        #: oldest + shard.buffer_dropped accounting)
+        self._buffers: Dict[str, deque] = {}
+        #: durable backend: with a journal attached, passivated
+        #: snapshots spill through it too, so they survive node death
+        self.store = StateStore(
+            spill=self._journal_spill if cluster.journal is not None else None
+        )
         self.passivation = PassivationPolicy(
             passivate_after_s
             if passivate_after_s is not None
@@ -307,27 +356,216 @@ class ShardRegion:
 
     # -- delivery ---------------------------------------------------- #
 
-    def deliver_local(self, key: str, payload: Any) -> None:
+    def deliver_local(
+        self, key: str, payload: Any, raise_overflow: bool = False
+    ) -> None:
         """Deliver to the local entity for ``key``, activating it (from
-        the passivation store or fresh) when absent."""
+        the passivation store, the journal, or fresh) when absent.
+        With a journal attached the command is appended — CRC-framed,
+        fsync per policy — BEFORE the entity can see it, so an ack the
+        entity later sends implies the command is replayable.  (The
+        journal is therefore an at-least-once log: a command the bound
+        then sheds or refuses was journaled but never acked — replay
+        may apply it, acked state can never regress.)
+
+        Delivery runs under the region lock, which makes the bounded-
+        mailbox admission REGION-granular backpressure by design: one
+        saturated key under the "block" policy slows every producer of
+        the type on this node (including the transport receive thread,
+        which is the propagation path back to remote senders)."""
+        journal = self.cluster.journal
         with self._lock:
             rec = self._entities.get(key)
             if rec is not None and rec.status != _ACTIVE:
-                buf = self._buffers.setdefault(key, [])
-                buf.append(payload)
-                if events.recorder.enabled:
-                    events.recorder.commit(
-                        events.SHARD_HANDOFF_BUFFERED,
-                        key=key,
-                        type=self.type_name,
-                        depth=len(buf),
-                    )
+                self._buffer_locked(key, payload)
                 return
             if rec is None:
                 snapshot = self.store.pop(key)
-                cell = self._spawn(key, snapshot, resumed=snapshot is not None)
+                resumed = snapshot is not None
+                replay: Optional[List[Any]] = None
+                if snapshot is None and journal is not None:
+                    recovered = self._recover_from_journal(key)
+                    if recovered is not None:
+                        snapshot, replay = recovered
+                cell = self._spawn(
+                    key,
+                    snapshot,
+                    resumed=resumed,
+                    recovered=replay is not None,
+                )
                 rec = self._entities[key] = _EntityRecord(cell)
-            rec.cell.tell(payload)
+                if replay:
+                    self._replay_commands(rec.cell, key, replay)
+            snap_epoch = None
+            if journal is not None and not isinstance(payload, _EntityCtl):
+                snap_epoch = self._journal_command(key, payload)
+            self._tell_entity(rec.cell, payload, raise_overflow)
+            if snap_epoch is not None:
+                rec.cell.tell_unbounded(
+                    _JournalSnapCmd(self, key, snap_epoch)
+                )
+
+    def _replay_commands(self, cell: "ActorCell", key: str, replay: List[Any]) -> None:
+        """Re-deliver a journal-recovered command tail through the
+        journaling path (one :meth:`_redeliver` per command)."""
+        journal = self.cluster.journal
+        for cmd in replay:
+            self._redeliver(cell, key, cmd, journal)
+
+    @staticmethod
+    def _tell_entity(cell: "ActorCell", payload: Any, raise_overflow: bool) -> None:
+        """Bounded enqueue on an entity cell.  Only a local
+        ``EntityRef.tell`` propagates the "error" policy's raise; every
+        other path (transport frames, replay, straggler forwards)
+        degrades to shed-oldest via the never-raising batch admission."""
+        if raise_overflow:
+            cell.tell(payload)
+            return
+        try:
+            cell.tell(payload)
+        except MailboxOverflowError:
+            cell.tell_batch([payload])
+
+    def _buffer_locked(self, key: str, payload: Any) -> None:
+        """Park one message behind an in-flight transition; caller
+        holds the region lock.  Bounded: past cluster.buffer_limit the
+        OLDEST parked message is shed, with accounting — never silent
+        unbounded growth while a shard is held."""
+        buf = self._buffers.setdefault(key, deque())
+        limit = self.cluster.buffer_limit
+        if limit and len(buf) >= limit:
+            buf.popleft()
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.SHARD_BUFFER_DROPPED,
+                    site="handoff",
+                    key=key,
+                    type=self.type_name,
+                )
+        buf.append(payload)
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SHARD_HANDOFF_BUFFERED,
+                key=key,
+                type=self.type_name,
+                depth=len(buf),
+            )
+
+    # -- durability plumbing (cluster/journal.py) --------------------- #
+
+    def _journal_command(self, key: str, payload: Any) -> Optional[int]:
+        """Append one delivered command; returns the bumped epoch when
+        a snapshot is due (the caller enqueues the capture command
+        BEHIND the payload it journaled).  Caller holds the region
+        lock, which is what sequences the epoch bump against the
+        commands it supersedes."""
+        journal = self.cluster.journal
+        shard = self.cluster.shard_of_key(key)
+        try:
+            blob = wire.encode_message(payload)
+        except Exception:
+            # Unpicklable payload: deliver it live, skip durability for
+            # this one message — a delivery failure would be worse than
+            # a replay hole.
+            return None
+        due = journal.note_command(self.type_name, shard, key, blob)
+        if due:
+            return journal.begin_snapshot(self.type_name, shard, key)
+        return None
+
+    def _journal_open(self, key: str, snapshot: Any) -> None:
+        """Activation-time epoch open (fresh/resumed/migrated/
+        recovered state becomes the new base record).  An unencodable
+        snapshot must NOT open a blank epoch — that would supersede a
+        valid prior image with nothing; extend the old epoch instead."""
+        journal = self.cluster.journal
+        if journal is None:
+            return
+        shard = self.cluster.shard_of_key(key)
+        if snapshot is None:
+            journal.open_epoch(self.type_name, shard, key, None)
+            return
+        try:
+            blob = wire.encode_message(snapshot)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            journal.continue_epoch(self.type_name, shard, key)
+            return
+        journal.open_epoch(self.type_name, shard, key, blob)
+
+    def _journal_spill(self, key: str, state: Any) -> None:
+        """StateStore durable backend: a passivated snapshot spills
+        through the journal too, so passivated entities survive node
+        death (recovered by whoever inherits the shard)."""
+        self._journal_open(key, state)
+
+    def _recover_from_journal(
+        self, key: str, fresh: bool = True
+    ) -> Optional[Tuple[Any, List[Any]]]:
+        """(state, replay_commands) decoded from the journal, or None.
+        Caller holds the region lock.  ``fresh`` re-scans the shard's
+        files first — the on-demand activation path must see every
+        append the previous owner flushed, or a stale image could
+        supersede its later acked commands; the eager member-death
+        sweep (recover_key) already invalidated once and passes False."""
+        journal = self.cluster.journal
+        shard = self.cluster.shard_of_key(key)
+        t0 = time.perf_counter()
+        if fresh:
+            journal.invalidate_shard(self.type_name, shard)
+        found = journal.recover(self.type_name, shard, key)
+        if found is None:
+            return None
+        state_blob, cmd_blobs = found
+        codec = self.cluster._codec
+        state = None
+        if state_blob is not None:
+            try:
+                state = wire.decode_message(codec, state_blob)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+        replay: List[Any] = []
+        skipped = 0
+        for blob in cmd_blobs:
+            try:
+                replay.append(wire.decode_message(codec, blob))
+            except Exception:
+                # A command whose refs no longer resolve (its sender's
+                # node died with it): counted, never a recovery abort.
+                skipped += 1
+        journal.recovered_entities += 1
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.JOURNAL_RECOVERED,
+                duration_s=time.perf_counter() - t0,
+                key=key,
+                type=self.type_name,
+                cmds=len(replay),
+                skipped=skipped,
+            )
+        return state, replay
+
+    def recover_key(self, key: str) -> bool:
+        """Eagerly reconstruct one journaled entity (the member-death
+        recovery sweep).  True when an entity was recovered."""
+        journal = self.cluster.journal
+        if journal is None:
+            return False
+        with self._lock:
+            if key in self._entities or self.store.contains(key):
+                return False
+            recovered = self._recover_from_journal(key, fresh=False)
+            if recovered is None:
+                return False
+            state, replay = recovered
+            cell = self._spawn(key, state, recovered=True)
+            self._entities[key] = _EntityRecord(cell)
+            self._replay_commands(cell, key, replay)
+        return True
 
     def _spawn(
         self,
@@ -335,6 +573,7 @@ class ShardRegion:
         snapshot: Any,
         resumed: bool = False,
         migrated: bool = False,
+        recovered: bool = False,
     ) -> "ActorCell":
         """Construct the entity cell as a root actor (a pseudoroot: the
         region, not the GC, decides when it dies).  Caller holds the
@@ -369,6 +608,14 @@ class ShardRegion:
             system._user_guardian,
             system.engine.root_spawn_info(),
         )
+        if cluster.entity_mailbox_limit:
+            cell.set_mailbox_bound(
+                cluster.entity_mailbox_limit, cluster.entity_overflow_policy
+            )
+        if cluster.journal is not None:
+            # New incarnation, new epoch: the state this cell starts
+            # from becomes the journal's base record for the key.
+            self._journal_open(key, snapshot)
         if migrated:
             tap = system.engine.tap
             if tap is not None:
@@ -385,6 +632,7 @@ class ShardRegion:
                 type=type_name,
                 resumed=resumed,
                 migrated=migrated,
+                recovered=recovered,
             )
         return cell
 
@@ -399,8 +647,11 @@ class ShardRegion:
             if rec is None or rec.status != _ACTIVE:
                 return False
             rec.status = status
-            self._buffers.setdefault(key, [])
-            rec.cell.tell(cmd)
+            self._buffers.setdefault(key, deque())
+            # Control commands bypass the mailbox bound: the capture
+            # MUST reach the entity even when its mailbox is saturated
+            # (and a blocked tell here would hold the region lock).
+            rec.cell.tell_unbounded(cmd)
             return True
 
     def _finish_transition(self, key: str) -> List[Any]:
@@ -418,7 +669,16 @@ class ShardRegion:
     def _reactivate(self, key: str, snapshot: Any, pending: List[Any],
                     migrated: bool = False) -> None:
         """Install a fresh cell for ``key`` (post-migration apply, or a
-        passivation that raced with new traffic) and deliver pending."""
+        passivation that raced with new traffic) and deliver pending.
+        With a journal, the spawn opened a fresh epoch from the shipped
+        snapshot and every pending/buffered delivery appends under it —
+        the migration-in checkpoint that makes acked-but-unprocessed
+        messages durable at the destination.  Deliveries here bypass
+        the mailbox bound: shipped pending was already admitted (and
+        possibly acked) at the source, buffered traffic already passed
+        the region's buffer cap — shedding either would lose admitted
+        state; bounds re-apply to new traffic."""
+        journal = self.cluster.journal
         with self._lock:
             buffered = self._buffers.pop(key, [])
             cell = self._spawn(
@@ -426,9 +686,27 @@ class ShardRegion:
             )
             self._entities[key] = _EntityRecord(cell)
             for payload in pending:
-                cell.tell(payload)
+                self._redeliver(cell, key, payload, journal)
             for payload in buffered:
-                cell.tell(payload)
+                self._redeliver(cell, key, payload, journal)
+
+    def _redeliver(self, cell: "ActorCell", key: str, payload: Any, journal) -> None:
+        """One reactivation/replay delivery.  Three invariants: (a)
+        these payloads were already admitted (acked, shipped, or
+        buffer-capped) — they bypass the mailbox bound, shedding them
+        would lose admitted state; (b) the payload is journaled (unless
+        it is a control command) BEFORE the enqueue; (c) a snapshot the
+        append triggers is enqueued IMMEDIATELY behind its triggering
+        command, so the captured state contains exactly the commands
+        journaled before the epoch bump — deferring it to the end of
+        the batch would fold post-bump commands into the snapshot AND
+        replay them again on the next recovery (double-apply)."""
+        snap_epoch = None
+        if journal is not None and not isinstance(payload, _EntityCtl):
+            snap_epoch = self._journal_command(key, payload)
+        cell.tell_unbounded(payload)
+        if snap_epoch is not None:
+            cell.tell_unbounded(_JournalSnapCmd(self, key, snap_epoch))
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -541,6 +819,51 @@ class ClusterSharding:
         self.retry_s = config.get_int("uigc.cluster.handoff-retry") / 1000.0
         self.max_hops = config.get_int("uigc.cluster.max-forward-hops")
         self.hold_timeout_s = config.get_int("uigc.cluster.hold-timeout") / 1000.0
+        #: per-key handoff/hold buffer cap (0 = unbounded legacy)
+        self.buffer_limit = config.get_int("uigc.cluster.buffer-limit")
+        #: global deferred-route queue cap
+        self.deferred_limit = config.get_int("uigc.cluster.deferred-limit")
+        self.entity_mailbox_limit = (
+            config.get_int("uigc.cluster.entity-mailbox-limit")
+            or config.get_int("uigc.runtime.mailbox-limit")
+        )
+        self.entity_overflow_policy = config.get_string(
+            "uigc.runtime.overflow-policy"
+        )
+        #: event-sourced entity journal (cluster/journal.py); None when
+        #: uigc.cluster.journal-dir is unset — the pre-durability mode
+        self.journal = None
+        journal_dir = config.get_string("uigc.cluster.journal-dir")
+        if journal_dir:
+            from .journal import EntityJournal
+
+            fabric_ref = system.fabric
+            address = system.address
+
+            def _journal_fault(nbytes: int):
+                # resolved per append so a plan set AFTER attach (or
+                # swapped mid-test) still injects
+                plan = getattr(fabric_ref, "fault_plan", None)
+                if plan is None:
+                    return None
+                return plan.journal_append(address, nbytes)
+
+            self.journal = EntityJournal(
+                journal_dir,
+                system.address,
+                fsync=config.get_string("uigc.cluster.journal-fsync"),
+                fsync_interval_s=config.get_int(
+                    "uigc.cluster.journal-fsync-interval"
+                )
+                / 1000.0,
+                segment_bytes=config.get_int(
+                    "uigc.cluster.journal-segment-bytes"
+                ),
+                snapshot_every=config.get_int(
+                    "uigc.cluster.journal-snapshot-every"
+                ),
+                fault_fn=_journal_fault if fabric_ref is not None else None,
+            )
         #: key -> shard memo: the blake2b in shard_of was a measurable
         #: slice of every routed message.  GIL-atomic dict ops, bounded
         #: by wholesale clear (hot keys re-warm in one burst).
@@ -553,7 +876,10 @@ class ClusterSharding:
         self._name_seq = itertools.count(1)
         #: routes that could not be sent (no link yet / table vacuum /
         #: hop limit) — retried every tick instead of being dropped
-        self._deferred: List[Tuple[str, str, Any]] = []
+        #: routes parked for table convergence; deque so the
+        #: shed-oldest cap pops O(1)
+        self._deferred: deque = deque()  # unbounded: capped by deferred_limit in _defer
+
         #: shard-grant protocol state.  A shard GAINED from a live
         #: previous owner is *held*: its traffic buffers here until the
         #: previous owner grants it (all its handoffs acked), it dies,
@@ -563,7 +889,7 @@ class ClusterSharding:
         #: migration snapshot — silently discarding the entity's state.
         self._holds: Dict[int, str] = {}
         self._hold_deadlines: Dict[int, float] = {}
-        self._hold_buffers: Dict[int, List[Tuple[str, str, Any]]] = {}
+        self._hold_buffers: Dict[int, deque] = {}
         #: shards we LOST: new owner plus the (type, key) handoffs that
         #: must complete before we grant the shard away.
         self._grant_watch: Dict[int, _GrantWatch] = {}
@@ -572,6 +898,15 @@ class ClusterSharding:
         #: table is NOT trustworthy — a joining node claims the whole
         #: keyspace for a moment — so those shards are held too.
         self._provisional = True
+        #: voluntary departures (the drain lifecycle): addresses that
+        #: asked to stop receiving placements but whose links are still
+        #: up for the handoffs — holds waiting on THEIR grants stay
+        #: armed, unlike a death verdict's.
+        self._leaving: set = set()
+        #: this node is draining: it excludes itself from placement,
+        #: rebroadcasts its departure every tick, and refuses to
+        #: re-adopt shards a stale peer table hands back.
+        self._draining = False
         self._closed = False
         self._ticks = 0
         #: last table version rebroadcast by the anti-entropy gossip
@@ -623,6 +958,8 @@ class ClusterSharding:
         if self._wire_frames:
             for kind in wire.SHARD_FRAME_KINDS:
                 fabric.register_frame_handler(kind, None)
+        if self.journal is not None:
+            self.journal.close()
         if self.system.cluster is self:
             self.system.cluster = None
 
@@ -675,9 +1012,20 @@ class ClusterSharding:
 
     # -- routing ----------------------------------------------------- #
 
-    def route(self, type_name: str, key: str, payload: Any, hops: int = 0) -> None:
+    def route(
+        self,
+        type_name: str,
+        key: str,
+        payload: Any,
+        hops: int = 0,
+        raise_overflow: bool = False,
+    ) -> None:
         """Deliver ``payload`` to the entity for ``key`` wherever it
-        currently lives."""
+        currently lives.  ``raise_overflow`` propagates a bounded-
+        mailbox "error" verdict to the caller — set only by a local
+        ``EntityRef.tell``; transport frames, deferred re-routes and
+        migration straggler forwards degrade to shed-oldest instead
+        (a raise there would kill a receive loop or the coordinator)."""
         shard = self.shard_of_key(key)
         home = self._table.owner(shard)
         if home is None:
@@ -689,7 +1037,18 @@ class ClusterSharding:
                     # Shard gained but not yet granted: hold the
                     # message so an on-demand spawn cannot race (and
                     # discard) the in-flight migration snapshot.
-                    buf = self._hold_buffers.setdefault(shard, [])
+                    buf = self._hold_buffers.setdefault(shard, deque())
+                    if self.buffer_limit and len(buf) >= self.buffer_limit:
+                        # account the message actually dropped (the
+                        # popped oldest), not the one being admitted
+                        d_type, d_key, _d_payload = buf.popleft()
+                        if events.recorder.enabled:
+                            events.recorder.commit(
+                                events.SHARD_BUFFER_DROPPED,
+                                site="hold",
+                                key=d_key,
+                                type=d_type,
+                            )
                     buf.append((type_name, key, payload))
                     held = len(buf)
                 else:
@@ -708,7 +1067,7 @@ class ClusterSharding:
             if region is None:
                 self._defer(type_name, key, payload)
                 return
-            region.deliver_local(key, payload)
+            region.deliver_local(key, payload, raise_overflow=raise_overflow)
             return
         if hops >= self.max_hops:
             # Tables are diverging (a rebalance in flight); park the
@@ -729,6 +1088,18 @@ class ClusterSharding:
 
     def _defer(self, type_name: str, key: str, payload: Any) -> None:
         with self._lock:
+            if (
+                self.deferred_limit
+                and len(self._deferred) >= self.deferred_limit
+            ):
+                d_type, d_key, _d_payload = self._deferred.popleft()
+                if events.recorder.enabled:
+                    events.recorder.commit(
+                        events.SHARD_BUFFER_DROPPED,
+                        site="deferred",
+                        key=d_key,
+                        type=d_type,
+                    )
             self._deferred.append((type_name, key, payload))
 
     # -- transport --------------------------------------------------- #
@@ -772,35 +1143,109 @@ class ClusterSharding:
 
     # -- coordinator-side handlers ----------------------------------- #
 
+    def _population_locked(self) -> int:
+        """Nodes that still participate in the grant protocol; caller
+        holds the lock.  Counts LEAVING nodes (alive, mid-drain, will
+        still grant) and a draining self (already out of _members): a
+        2-node cluster mid-drain is NOT a sole survivor, and treating
+        it as one would release holds and let on-demand spawns race
+        the drain's in-flight migrations."""
+        return (
+            len(self._members)
+            + len(self._leaving)
+            + (1 if self._draining else 0)
+        )
+
     def _member_up(self, address: str) -> None:
         with self._lock:
+            self._leaving.discard(address)
             if address in self._members:
                 return
             self._members.add(address)
         self._recompute_table()
 
+    def _member_leaving(self, address: str) -> None:
+        """Voluntary departure (the drain lifecycle, "sleave" frame):
+        stop PLACING on the node but keep every hold waiting on its
+        grants armed — it is alive and migrating its entities to us."""
+        if address == self.address:
+            return
+        with self._lock:
+            already = address in self._leaving
+            self._leaving.add(address)
+            if address not in self._members:
+                if already:
+                    return  # re-broadcast of a departure we adopted
+                was_member = False
+            else:
+                was_member = True
+                self._members.discard(address)
+        if was_member:
+            self._recompute_table()
+            self._flush_deferred()
+
     def _member_removed(self, address: str) -> None:
         with self._lock:
-            if address not in self._members:
+            self._leaving.discard(address)
+            was_member = address in self._members
+            touched = self._forget_dead_locked(address)
+            if not was_member and not touched:
                 return
             self._members.discard(address)
-            # Holds waiting on the dead node release immediately (its
-            # grant will never come — and its state died with it);
-            # grant watches pointing at it are obsolete, the recompute
-            # below re-targets those shards.
-            for shard in [
-                s for s, owner in self._holds.items() if owner == address
-            ]:
-                self._release_hold_locked(shard)
-            for shard in [
-                s
-                for s, watch in self._grant_watch.items()
-                if watch.owner == address
-            ]:
-                del self._grant_watch[shard]
+            old_assignments = dict(self._table.assignments)
         self._recompute_table()
         self.migrations.retarget_dead(address)
+        if self.journal is not None:
+            # Peer files may hold state we must now serve: drop stale
+            # scan caches, then eagerly reconstruct the journaled
+            # entities of every shard we inherited from the dead node.
+            self.journal.invalidate_cache()
+            self._recover_inherited(address, old_assignments)
         self._flush_deferred()
+
+    def _forget_dead_locked(self, address: str) -> bool:
+        """Release grant/hold state pointing at a dead address; caller
+        holds the lock.  True when anything referenced it (so a death
+        verdict for an already-left member still cleans up)."""
+        touched = False
+        for shard in [
+            s for s, owner in self._holds.items() if owner == address
+        ]:
+            self._release_hold_locked(shard)
+            touched = True
+        for shard in [
+            s
+            for s, watch in self._grant_watch.items()
+            if watch.owner == address
+        ]:
+            del self._grant_watch[shard]
+            touched = True
+        return touched
+
+    def _recover_inherited(
+        self, dead: str, old_assignments: Dict[int, str]
+    ) -> None:
+        """Journal-recover every entity of a shard that moved
+        ``dead`` -> this node.  Restricted to gained-from-dead shards:
+        a shard gained from a LIVE owner gets its state via the
+        migration protocol, and recovering a stale journal copy under
+        it would race (and lose against) the authoritative handoff."""
+        table = self._table
+        for region in list(self._regions.values()):
+            for shard in self.journal.shards(region.type_name):
+                if table.owner(shard) != self.address:
+                    continue
+                if old_assignments.get(shard) != dead:
+                    continue
+                for key in self.journal.keys_for_shard(
+                    region.type_name, shard
+                ):
+                    try:
+                        region.recover_key(key)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
 
     def rebalance(self) -> None:
         """Explicit rebalance kick: recompute from the current member
@@ -810,6 +1255,72 @@ class ClusterSharding:
         coordinator's grant pass into granting a freshly lost shard
         before its keys are registered."""
         self._coordinator.tell(_Rebalance())
+
+    # -- drain lifecycle (zero-downtime rolling restart) -------------- #
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Gracefully empty this node: stop accepting placements
+        (broadcast a "sleave", exclude self from the table), hand off
+        every hosted entity — live AND passivated — through the
+        existing migration/grant protocol, checkpoint the journal, and
+        wait until nothing remains.  Returns True when fully drained
+        within the timeout; False leaves whatever residue the journal
+        can still recover after the restart.
+
+        The restart half needs no inverse call: a fresh process on the
+        same address reconnects, peers see MemberUp, and the rebalance
+        migrates its share of the keyspace back."""
+        t0 = time.monotonic()
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+            self._members.discard(self.address)
+        if first and events.recorder.enabled:
+            events.recorder.commit(events.NODE_DRAINING, address=self.address)
+        self._broadcast_leave()
+        self._coordinator.tell(_Rebalance())
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._drained():
+                break
+            time.sleep(0.02)
+        if self.journal is not None:
+            self.journal.checkpoint()
+        drained = self._drained()
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.NODE_DRAINED,
+                duration_s=time.monotonic() - t0,
+                address=self.address,
+                complete=drained,
+            )
+        return drained
+
+    def _drained(self) -> bool:
+        """Nothing left to hand off: no pending migrations, no grant
+        watches, no entity records, no parked traffic, no spilled
+        state."""
+        if self.migrations.pending_count():
+            return False
+        with self._lock:
+            if self._grant_watch:
+                return False
+            if self._deferred or self._hold_buffers:
+                return False
+            regions = list(self._regions.values())
+        for region in regions:
+            with region._lock:
+                if region._entities or any(region._buffers.values()):
+                    return False
+            if region.store.size():
+                return False
+        return True
+
+    def _broadcast_leave(self) -> None:
+        frame = wire.encode_shard_leave(self.address)
+        for member in self.members():
+            if member != self.address:
+                self._send_frame(member, frame)
 
     def _recompute_table(self, force: bool = False) -> None:
         with self._lock:
@@ -840,6 +1351,13 @@ class ClusterSharding:
             old = self._table.assignments
             self._table = incoming
             self._table_transition(old, assignments)
+            # A stale peer (one that missed the "sleave") may hand a
+            # draining node its shards back; adopt for ordering, then
+            # immediately supersede with a self-excluding recompute
+            # (the tick's sleave re-broadcast heals the peer's view).
+            readopted = self._draining and any(
+                owner == self.address for owner in assignments.values()
+            )
         if events.recorder.enabled:
             events.recorder.commit(
                 events.SHARD_TABLE,
@@ -847,6 +1365,9 @@ class ClusterSharding:
                 shards=len(assignments),
                 origin=origin,
             )
+        if readopted:
+            self._recompute_table(force=True)
+            return
         self._scan_handoffs()
 
     def _table_transition(self, old: Dict[int, str], new: Dict[int, str]) -> None:
@@ -856,7 +1377,7 @@ class ClusterSharding:
         grant it once our handoffs for it complete."""
         now = time.monotonic()
         was_provisional = self._provisional
-        new_provisional = len(self._members) <= 1
+        new_provisional = self._population_locked() <= 1
         self._provisional = new_provisional
         if new_provisional:
             # Sole member again: there is nobody left to wait on.
@@ -869,7 +1390,7 @@ class ClusterSharding:
                 if (
                     prev is not None
                     and prev != self.address
-                    and prev in self._members
+                    and (prev in self._members or prev in self._leaving)
                 ):
                     # Gained from a live previous owner: hold until ITS
                     # grant (or death, or timeout).
@@ -916,7 +1437,7 @@ class ClusterSharding:
 
     def _flush_deferred(self) -> None:
         with self._lock:
-            deferred, self._deferred = self._deferred, []
+            deferred, self._deferred = self._deferred, deque()  # unbounded: capped by deferred_limit in _defer
         for type_name, key, payload in deferred:
             self.route(type_name, key, payload)
 
@@ -1042,11 +1563,15 @@ class ClusterSharding:
         # gossip immediately when the version moved, else every 5th tick.
         if self._table.version != self._gossiped_version or self._ticks % 5 == 0:
             self._gossip()
+        if self._draining:
+            # Re-broadcast the departure until death: a peer that
+            # missed the one-shot "sleave" keeps assigning shards back.
+            self._broadcast_leave()
         self.migrations.retry_due()
         now = time.monotonic()
         with self._lock:
             regions = list(self._regions.values())
-            multi_member = len(self._members) > 1
+            multi_member = self._population_locked() > 1
             for shard in [
                 s for s, d in self._hold_deadlines.items() if d <= now
             ]:
@@ -1065,6 +1590,20 @@ class ClusterSharding:
                     if self._moves_away(key):
                         self._watch_key(region.type_name, key)
                         self.migrations.ship_passive(region, key)
+        if self.journal is not None:
+            self.journal.flush_due()
+            # Segment rolls queue re-snapshots so old segments compact;
+            # enqueue a capture for every owed key that is active here.
+            for type_name, shard, key in self.journal.resnap_due():
+                region = self._regions.get(type_name)
+                if region is None:
+                    continue
+                with region._lock:
+                    rec = region._entities.get(key)
+                    if rec is None or rec.status != _ACTIVE or rec.cell is None:
+                        continue
+                    epoch = self.journal.begin_snapshot(type_name, shard, key)
+                    rec.cell.tell_unbounded(_JournalSnapCmd(region, key, epoch))
         self._grant_ready()
         self._flush_deferred()
 
@@ -1113,6 +1652,10 @@ class ClusterSharding:
                 )
             if granted:
                 self._release_hold(shard)
+        elif kind == "sleave":
+            origin = wire.decode_shard_leave(frame)
+            if origin is not None:
+                self._member_leaving(origin)
 
     # -- observability ----------------------------------------------- #
 
@@ -1127,6 +1670,22 @@ class ClusterSharding:
             return self._table.version
         if field == "migrations_pending":
             return self.migrations.pending_count()
+        if field == "journal_unsynced":
+            return (
+                self.journal.unsynced_records()
+                if self.journal is not None
+                else None
+            )
+        if field == "journal_live_keys":
+            return (
+                self.journal.live_keys() if self.journal is not None else None
+            )
+        if field == "journal_segments":
+            return (
+                self.journal.segment_count()
+                if self.journal is not None
+                else None
+            )
         with self._lock:
             regions = list(self._regions.values())
         if field == "active":
@@ -1145,14 +1704,21 @@ class ClusterSharding:
             regions = list(self._regions.values())
             table = self._table
             held = len(self._holds)
-        return {
+            draining = self._draining
+            leaving = sorted(self._leaving)
+        out = {
             "table_version": table.version,
             "table_size": len(table.assignments),
             "held_shards": held,
             "members": self.members(),
+            "draining": draining,
+            "leaving": leaving,
             "active": sum(r.active_count() for r in regions),
             "passivated": sum(r.passive_count() for r in regions),
             "buffered": sum(r.buffered_depth() for r in regions),
             "migrations_pending": self.migrations.pending_count(),
             "regions": [r.stats() for r in regions],
         }
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
+        return out

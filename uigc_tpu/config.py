@@ -186,6 +186,39 @@ DEFAULTS: Dict[str, Any] = {
     # rebalance from spawning a fresh on-demand entity that would win
     # against — and silently discard — the in-flight migrated state.
     "uigc.cluster.hold-timeout": 3000,
+    # --- Durability plane (uigc_tpu/cluster/journal.py) ---
+    # Base directory of the event-sourced entity journal; "" disables
+    # journaling entirely (the pre-durability behavior: entity state
+    # dies with the node).  Nodes of one cluster share the directory
+    # (shared-disk model); each node appends only to its own per-shard
+    # segment files, so there is no write contention.
+    "uigc.cluster.journal-dir": "",
+    # When appended records reach the disk: "always" fsyncs per append
+    # (every acked command is crash-durable), "interval" fsyncs on the
+    # journal-fsync-interval cadence (bounded loss window), "never"
+    # leaves flushing to the OS.
+    "uigc.cluster.journal-fsync": "interval",
+    # Milliseconds between interval-mode fsync sweeps (driven by the
+    # cluster tick).
+    "uigc.cluster.journal-fsync-interval": 50,
+    # Segment roll threshold, in bytes: a shard segment past this size
+    # rolls to a fresh file and the entities whose epoch lives in the
+    # old one are re-snapshotted so the old segment compacts away.
+    "uigc.cluster.journal-segment-bytes": 1 << 20,
+    # Commands journaled per entity between automatic snapshot records
+    # (bounds replay length after a crash).
+    "uigc.cluster.journal-snapshot-every": 64,
+    # Per-key cap on the EntityRef buffer-during-handoff path (and the
+    # per-shard hold buffers); past it the oldest buffered message is
+    # shed with a shard.buffer_dropped event +
+    # uigc_entity_buffer_dropped_total.  0 = unbounded (legacy).
+    "uigc.cluster.buffer-limit": 4096,
+    # Global cap on the deferred-route queue (messages parked waiting
+    # for table convergence); same shed-oldest accounting.
+    "uigc.cluster.deferred-limit": 65536,
+    # Mailbox bound applied to entity cells specifically; 0 inherits
+    # uigc.runtime.mailbox-limit.
+    "uigc.cluster.entity-mailbox-limit": 0,
     # --- Correctness tooling (uigc_tpu/analysis; no reference analogue,
     # the reference debugged with in-source asserts) ---
     # Attach the uigcsan online sanitizer at system creation: a shadow
@@ -319,12 +352,36 @@ DEFAULTS: Dict[str, Any] = {
     # Frame gap/duplicate rate (frames/s over the rule window) above
     # which the spike rules fire.
     "uigc.telemetry.alert-gap-rate": 1.0,
+    # Backpressure-rate (fabric.backpressure events/s over the rule
+    # window) above which the backpressure_spike alert fires.
+    "uigc.telemetry.alert-backpressure-rate": 5.0,
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,
     # Maximum messages an actor processes per scheduling slot (Akka calls
     # this dispatcher "throughput").
     "uigc.runtime.throughput": 16,
+    # Application-mailbox bound per cell, in messages; 0 = unbounded
+    # (legacy).  A full mailbox applies the overflow policy below and
+    # commits a fabric.backpressure event — on a remote delivery path
+    # the "block" policy stalls the transport's receive thread, which
+    # stalls the TCP stream, which surfaces on the SENDER as writer-
+    # queue pushback: end-to-end backpressure with no protocol changes.
+    # System messages (the stop protocol) are never bounded.
+    "uigc.runtime.mailbox-limit": 0,
+    # What a full mailbox does to the incoming message:
+    #   "block"       the sender waits (up to mailbox-block-ms) for
+    #                 space; on timeout — or when the sender is the
+    #                 cell's own processing thread, where waiting would
+    #                 deadlock — degrade to shed-oldest
+    #   "shed-oldest" drop the oldest queued message through the
+    #                 dead-letter accounting and admit the new one
+    #   "error"       raise MailboxOverflowError to a LOCAL sender;
+    #                 batch/transport deliveries degrade to shed-oldest
+    #                 (a raise would kill the link's receive loop)
+    "uigc.runtime.overflow-policy": "block",
+    # Upper bound on one blocked send, in milliseconds.
+    "uigc.runtime.mailbox-block-ms": 2000,
 }
 
 

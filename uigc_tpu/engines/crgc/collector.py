@@ -229,7 +229,17 @@ class Bookkeeper(RawBehavior):
         if peer_system is None:
             return
         self.remote_gcs[address] = peer_system.engine.bookkeeper_cell
-        if address not in self.undo_logs:
+        if address in self.downed_gcs:
+            # Rolling-restart rejoin: a FRESH incarnation of a downed
+            # address (the fabric only re-admits new nonces).  Its GC
+            # stream starts from zero, so the old incarnation's undo
+            # log must not absorb the newcomer's deltas — reset it.
+            # If the old log was still awaiting its fold quorum, the
+            # skipped fold can only LEAK the dead incarnation's refs
+            # (marks stay), never collect a live actor: safe direction.
+            self.downed_gcs.discard(address)
+            self.undo_logs[address] = UndoLog(address)
+        elif address not in self.undo_logs:
             self.undo_logs[address] = UndoLog(address)
         # Establish both link directions eagerly (the Artery-handshake
         # analogue) so crash-time finalization always has an ingress,

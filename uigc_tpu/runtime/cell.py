@@ -29,6 +29,7 @@ from typing import TYPE_CHECKING, Any, Callable, Dict, List, Optional
 from ..engines.engine import TerminationDecision
 from ..interfaces import GCMessage, Message
 from ..utils import events
+from ..utils.validation import InvariantViolation
 from .behaviors import SameBehavior, StoppedBehavior
 from .signals import PostStop, Terminated
 
@@ -39,6 +40,13 @@ if TYPE_CHECKING:  # pragma: no cover
 _ACTIVE = 0
 _STOPPING = 1
 _TERMINATED = 2
+
+
+class MailboxOverflowError(InvariantViolation):
+    """A bounded mailbox refused a message under the ``"error"``
+    overflow policy (uigc.runtime.mailbox-limit) — raised to the LOCAL
+    sender; batch/transport deliveries degrade to shed-oldest instead,
+    because a raise there would kill the link's receive loop."""
 
 
 class _SysStop:
@@ -89,6 +97,10 @@ class ActorCell:
         "on_finished_processing",
         "_last_active",
         "_anon_counter",
+        "mailbox_limit",
+        "overflow_policy",
+        "_space_cv",
+        "_batch_tid",
         "__weakref__",  # the wire codec's uid registry holds cells weakly
     )
 
@@ -111,13 +123,13 @@ class ActorCell:
         self.is_managed = is_managed
         self.behavior: Any = None
         self.context: Any = None
-        self._mailbox: deque = deque()
+        self._mailbox: deque = deque()  # unbounded: bounded by the mailbox_limit admission in tell/tell_batch
         #: messages bulk-claimed by the running batch but not yet
         #: invoked — logically the mailbox HEAD.  Touched only by the
         #: thread that owns the batch (the ``_scheduled`` holder), so
         #: its pops are lock-free; drain/finalize fold it back in.
         self._claimed: deque = deque()
-        self._sysbox: deque = deque()
+        self._sysbox: deque = deque()  # unbounded: the stop protocol must never shed; depth is O(children)
         self._lock = threading.Lock()
         # Pre-claimed: no batch may run until start() releases the cell,
         # so messages sent from the behavior's own constructor can't be
@@ -136,6 +148,21 @@ class ActorCell:
         #: passivation (uigc_tpu/cluster/passivation.py).
         self._last_active = time.monotonic()
         self._anon_counter = 0
+        #: application-mailbox bound (0 = unbounded) + the policy a
+        #: full mailbox applies to the incoming message; defaults from
+        #: uigc.runtime.mailbox-limit / overflow-policy, overridable
+        #: per cell (set_mailbox_bound — entity cells get the cluster's
+        #: bound).  System messages are never bounded, and neither are
+        #: unmanaged cells (Bookkeeper/coordinators: shedding GC
+        #: control would corrupt the collector protocol).
+        self.mailbox_limit = system.mailbox_limit if is_managed else 0
+        self.overflow_policy = system.overflow_policy
+        #: space-available signal for blocked senders; allocated lazily
+        #: on the first blocking admission
+        self._space_cv: Optional[threading.Condition] = None
+        #: thread currently running _process_batch — a sender that IS
+        #: that thread must never block on its own cell's bound
+        self._batch_tid = 0
 
     # ------------------------------------------------------------------ #
     # Message delivery
@@ -144,14 +171,29 @@ class ActorCell:
     def tell(self, msg: Any) -> None:
         """Enqueue an application-level message (a GCMessage envelope from a
         managed sender, or a raw payload destined for a root actor)."""
+        shed = None
         with self._lock:
             if self._lifecycle != _ACTIVE:
                 dead = True
             else:
                 dead = False
-                self._mailbox.append(msg)
-                self._last_active = time.monotonic()
-                dispatch = self._mark_scheduled()
+                if (
+                    self.mailbox_limit
+                    and len(self._mailbox) >= self.mailbox_limit
+                ):
+                    shed = self._admit_locked(1, allow_raise=True)
+                    if self._lifecycle != _ACTIVE:
+                        # The cell terminated while we were blocked on
+                        # admission: its mailbox is already drained —
+                        # fall through to dead-letter, never append.
+                        dead = True
+                if not dead:
+                    self._mailbox.append(msg)
+                    self._last_active = time.monotonic()
+                    dispatch = self._mark_scheduled()
+        if shed:
+            for old in shed:
+                self.system.record_dead_letter(self, old)
         if dead:
             self.system.record_dead_letter(self, msg)
             return
@@ -176,13 +218,39 @@ class ActorCell:
             return
         dead = None
         dispatch = False
+        shed = None
         with self._lock:
             if self._lifecycle != _ACTIVE:
                 dead = msgs
             else:
-                self._mailbox.extend(msgs)
-                self._last_active = time.monotonic()
-                dispatch = self._mark_scheduled()
+                if (
+                    self.mailbox_limit
+                    and len(self._mailbox) + len(msgs) > self.mailbox_limit
+                ):
+                    # Transport deliveries never raise: "error" (like a
+                    # block timeout) degrades to shed-oldest here.
+                    shed = self._admit_locked(len(msgs), allow_raise=False)
+                    if self._lifecycle != _ACTIVE:
+                        # Terminated while blocked on admission: the
+                        # mailbox is drained — dead-letter the run.
+                        dead = msgs
+                if dead is None:
+                    self._mailbox.extend(msgs)
+                    if (
+                        self.mailbox_limit
+                        and len(self._mailbox) > self.mailbox_limit
+                    ):
+                        # A run longer than the whole bound sheds from
+                        # its own head — FIFO preserved, control
+                        # payloads skipped.
+                        trimmed = self._shed_from_head_locked(0)
+                        if trimmed:
+                            shed = (shed or []) + trimmed
+                    self._last_active = time.monotonic()
+                    dispatch = self._mark_scheduled()
+        if shed:
+            for old in shed:
+                self.system.record_dead_letter(self, old)
         if dead is not None:
             for msg in dead:
                 self.system.record_dead_letter(self, msg)
@@ -199,6 +267,139 @@ class ActorCell:
                 )
         if dispatch:
             self._dispatcher.execute(self._process_batch)
+
+    def tell_unbounded(self, msg: Any) -> None:
+        """Enqueue bypassing the mailbox bound: the channel for control
+        payloads (migration/passivation/journal captures) that must
+        reach a saturated entity without blocking their sender — which
+        may hold region locks."""
+        with self._lock:
+            if self._lifecycle != _ACTIVE:
+                dead = True
+            else:
+                dead = False
+                self._mailbox.append(msg)
+                self._last_active = time.monotonic()
+                dispatch = self._mark_scheduled()
+        if dead:
+            self.system.record_dead_letter(self, msg)
+            return
+        if self.system.sched_events and events.recorder.enabled:
+            events.recorder.commit(
+                events.SCHED_ENQUEUE,
+                cell=self.uid,
+                path=self.path,
+                kind="app",
+                thread=threading.get_ident(),
+            )
+        if dispatch:
+            self._dispatcher.execute(self._process_batch)
+
+    def set_mailbox_bound(self, limit: int, policy: Optional[str] = None) -> None:
+        """Bound this cell's application mailbox (0 = unbounded)."""
+        self.mailbox_limit = max(0, int(limit))
+        if policy is not None:
+            self.overflow_policy = policy
+
+    def _admit_locked(self, n: int, allow_raise: bool) -> Optional[list]:
+        """Apply the overflow policy for ``n`` incoming messages;
+        caller holds ``_lock`` and found the bound exceeded.  Returns
+        messages shed from the mailbox head, to be dead-lettered AFTER
+        the lock is released (engine accounting must not run under the
+        cell lock), or None when the wait made room."""
+        policy = self.overflow_policy
+        limit = self.mailbox_limit
+        if policy == "error":
+            if allow_raise:
+                if events.recorder.enabled:
+                    events.recorder.commit(
+                        events.BACKPRESSURE,
+                        site="mailbox",
+                        action="error",
+                        path=self.path,
+                        depth=len(self._mailbox),
+                        policy=policy,
+                    )
+                raise MailboxOverflowError(
+                    "mailbox.overflow",
+                    f"bounded mailbox of {self.path} is full",
+                    path=self.path,
+                    limit=limit,
+                    depth=len(self._mailbox),
+                )
+            policy = "shed-oldest"
+        if policy == "block" and threading.get_ident() != self._batch_tid:
+            # The admission wait IS the backpressure: on a transport
+            # delivery path this stalls the link's receive thread,
+            # which stalls the TCP stream, which surfaces on the peer
+            # as writer-queue pushback.
+            if self._space_cv is None:
+                self._space_cv = threading.Condition(self._lock)
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.BACKPRESSURE,
+                    site="mailbox",
+                    action="wait",
+                    path=self.path,
+                    depth=len(self._mailbox),
+                    policy=policy,
+                )
+            deadline = time.monotonic() + self.system.mailbox_block_s
+            while (
+                len(self._mailbox) + n > limit
+                and self._lifecycle == _ACTIVE
+                # A run larger than the whole bound can never fit: once
+                # the mailbox is drained, waiting longer is pure stall
+                # — fall through to shedding immediately.
+                and self._mailbox
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._space_cv.wait(min(0.05, remaining))
+            if len(self._mailbox) + n <= limit or self._lifecycle != _ACTIVE:
+                return None
+            # Timed out against a wedged consumer: degrade to shedding
+            # rather than wedging the sender forever.
+        shed = self._shed_from_head_locked(n)
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.BACKPRESSURE,
+                site="mailbox",
+                action="shed",
+                path=self.path,
+                depth=len(self._mailbox),
+                policy=self.overflow_policy,
+                count=len(shed),
+            )
+        return shed
+
+    def _shed_from_head_locked(self, n_incoming: int) -> list:
+        """Pop sheddable messages from the mailbox head until
+        ``n_incoming`` more fit under the bound.  Control payloads
+        (``uigc_unsheddable``, enqueued via tell_unbounded — migration/
+        passivation/journal captures) are skipped and restored in
+        order: shedding a capture would wedge its key's transition
+        forever.  The mailbox may therefore stay above the bound by
+        the number of control messages present (a small constant)."""
+        limit = self.mailbox_limit
+        shed: list = []
+        kept: list = []
+        budget = len(self._mailbox)
+        while (
+            self._mailbox
+            and budget > 0
+            and len(self._mailbox) + len(kept) + n_incoming > limit
+        ):
+            old = self._mailbox.popleft()
+            budget -= 1
+            if getattr(old, "uigc_unsheddable", False):
+                kept.append(old)
+            else:
+                shed.append(old)
+        if kept:
+            self._mailbox.extendleft(reversed(kept))
+        return shed
 
     def tell_system(self, msg: Any) -> None:
         with self._lock:
@@ -242,6 +443,9 @@ class ActorCell:
     def _process_batch(self) -> None:
         throughput = self.system.throughput
         processed = 0
+        # Blocked-admission guard: a behavior sending to its OWN full
+        # mailbox must shed, not deadlock against itself.
+        self._batch_tid = threading.get_ident()
         # Scheduling taps for the race detector (analysis/race.py): the
         # batch_start/batch_end pair brackets this thread's exclusive
         # ownership of the cell; batch_end is committed BEFORE the
@@ -292,6 +496,10 @@ class ActorCell:
                 else:
                     for _ in range(take):
                         claimed.append(mailbox.popleft())
+                if self._space_cv is not None and claimed:
+                    # Space opened: release blocked bounded-mailbox
+                    # senders (the backpressure valve).
+                    self._space_cv.notify_all()
             if not claimed:
                 break
             self._needs_block_hook = True
@@ -383,6 +591,10 @@ class ActorCell:
                 thread=threading.get_ident(),
             )
         with self._lock:
+            # Release the self-send guard BEFORE ownership: a pooled
+            # worker that later runs a DIFFERENT cell's batch must not
+            # inherit this cell's skip-the-wait admission.
+            self._batch_tid = 0
             if self._lifecycle != _TERMINATED and (self._mailbox or self._sysbox):
                 redispatch = True
             else:
@@ -562,6 +774,10 @@ class ActorCell:
             self._claimed.clear()
             watchers = list(self._watchers)
             self._watchers.clear()
+            if self._space_cv is not None:
+                # Terminal state: blocked senders re-check lifecycle
+                # and fall through to dead-letter, never wedge.
+                self._space_cv.notify_all()
         if sched:
             # Committed before the parent is notified, so a parent's
             # poststop event is always sequenced after every child's
@@ -622,6 +838,8 @@ class ActorCell:
             msgs = list(self._claimed) + list(self._mailbox)
             self._claimed.clear()
             self._mailbox.clear()
+            if self._space_cv is not None:
+                self._space_cv.notify_all()
         return msgs
 
     def watch(self, other: "ActorCell") -> None:

@@ -116,6 +116,10 @@ class FaultPlan:
         self._inbound: List[_Rule] = []
         self._partitions: set = set()  # frozenset({a, b})
         self._crash_at: Dict[str, int] = {}
+        #: address -> [appends_remaining, keep_bytes, keep_fraction]
+        #: for the torn-journal-append injection (crash-at-byte)
+        self._journal_crash: Dict[str, list] = {}
+        self._journal_appends: Counter = Counter()
         self._sent: Counter = Counter()
         self._rngs: Dict[Tuple[str, str], random.Random] = {}
         self._lock = threading.Lock()
@@ -184,6 +188,28 @@ class FaultPlan:
         transmitted (or dropped) ``after_frames`` frames."""
         with self._lock:
             self._crash_at[address] = after_frames
+        return self
+
+    def torn_journal_append(
+        self,
+        address: str,
+        after_appends: int,
+        keep_bytes: Optional[int] = None,
+        keep_fraction: float = 0.5,
+    ) -> "FaultPlan":
+        """Crash-at-byte injection for the entity journal
+        (uigc_tpu/cluster/journal.py): on ``address``'s N-th append
+        (1-based, counted from now), only a PREFIX of the framed record
+        reaches the file — ``keep_bytes`` bytes, or ``keep_fraction``
+        of the frame when unset — and the journal goes dead, the way a
+        process dies mid-``write``.  Recovery must stop replay cleanly
+        at the last valid CRC frame and report ``journal.torn_record``."""
+        with self._lock:
+            self._journal_crash[address] = [
+                int(after_appends),
+                keep_bytes,
+                keep_fraction,
+            ]
         return self
 
     # ------------------------------------------------------------- #
@@ -268,6 +294,27 @@ class FaultPlan:
                 del self._crash_at[address]
                 return True
         return False
+
+    def journal_append(self, address: str, nbytes: int) -> Optional[int]:
+        """Torn-append verdict for one journal record of ``nbytes``
+        framed bytes about to be written by ``address``.  Returns None
+        to write fully, or the number of bytes (< nbytes) to write
+        before the simulated crash; the trigger fires exactly once."""
+        with self._lock:
+            spec = self._journal_crash.get(address)
+            self._journal_appends[address] += 1
+            if spec is None:
+                return None
+            spec[0] -= 1
+            if spec[0] > 0:
+                return None
+            del self._journal_crash[address]
+            keep = spec[1]
+            if keep is None:
+                keep = int(nbytes * spec[2])
+            keep = max(1, min(int(keep), nbytes - 1))
+            self.stats[("torn-journal", address, "")] += 1
+            return keep
 
     def frames_sent(self, address: str) -> int:
         with self._lock:

@@ -194,6 +194,7 @@ class _PeerState:
         "reconnecting",
         "pending_break",
         "nonce",
+        "retired",
         "outq",
         "out_ev",
         "out_cv",
@@ -228,7 +229,7 @@ class _PeerState:
         self.dups = 0
         self.held: Optional[tuple] = None  # (seq, frame, truncate) reorder hold
         self.stall = 0  # frames still to absorb into the stall queue
-        self.stall_q: list = []
+        self.stall_q: list = []  # unbounded: holds at most the DELAY rule's `frames` budget
         self.dial: Optional[Tuple[str, int]] = None
         self.reconnecting = False
         #: a conn that broke WHILE a reconnect was in flight; replayed
@@ -237,12 +238,15 @@ class _PeerState:
         self.pending_break: Optional["_Conn"] = None
         #: the peer incarnation this stream state belongs to
         self.nonce: Optional[int] = None
+        #: superseded by a rejoining NEW incarnation of the address: the
+        #: old writer must exit even though the address is live again
+        self.retired = False
         #: bounded outbound job queue drained by the writer thread.
         #: CPython deque appends are atomic, so senders enqueue
         #: LOCK-FREE; the writer (single consumer) assigns sequence
         #: numbers, stamps egress windows and runs fault verdicts in
         #: pop order, which IS the stream order.
-        self.outq: deque = deque()
+        self.outq: deque = deque()  # unbounded: capped by the writer high-water admission in _enqueue_job
         #: writer wake-up: set by senders on the empty->nonempty
         #: transition (Event.set is thread-safe and needs no lock),
         #: cleared by the writer before it sleeps
@@ -633,10 +637,13 @@ class NodeFabric:
 
     def _install_peer(self, conn: _Conn, hello: tuple) -> bool:
         """Adopt a handshaken connection.  Returns False when the peer
-        was already declared dead (a removed member cannot silently
-        rejoin — recovery already reverted its effects) or when a known
-        address presents a NEW incarnation nonce (the old process died;
-        a restarted one may not resume its frame stream).
+        is the SAME incarnation of an address already declared dead (a
+        removed member cannot silently rejoin — recovery already
+        reverted its effects).  A NEW incarnation (restart nonce) of a
+        dead address IS admitted: the rolling-restart rejoin — the old
+        incarnation's death verdict ran (or runs now), its transport
+        state retires, and the newcomer joins with a completely fresh
+        stream (fresh sequence numbers, fresh egress/ingress windows).
 
         Tolerant unpack: the hello is ``(kind, address, names, bk_uid,
         nonce)`` with an optional trailing capabilities element — never
@@ -648,6 +655,54 @@ class NodeFabric:
         except TypeError:
             caps = frozenset()
         conn.address = address
+        # Restart detection BEFORE adopting state: a known address
+        # presenting a new nonce means the incarnation we were linked
+        # to is gone — run its death verdict, then fall through to the
+        # rejoin admission below (one dial, not a refuse-then-retry).
+        with self._lock:
+            old = self._peers.get(address)
+            stale = (
+                address in self._conns
+                and address not in self.crashed
+                and old is not None
+                and old.nonce is not None
+                and old.nonce != nonce
+            )
+        if stale:
+            self._declare_dead(address, "restart")
+        retired = None
+        with self._lock:
+            if address in self.crashed:
+                old = self._peers.get(address)
+                if old is not None and old.nonce == nonce:
+                    return False  # the SAME dead incarnation: refuse
+                # Rolling-restart rejoin: retire the dead incarnation's
+                # transport state wholesale — stream numbering, links,
+                # cached proxies — so the newcomer starts from zero on
+                # both sides (its fabric is fresh-built anyway).
+                self.crashed.discard(address)
+                if old is not None:
+                    old.retired = True
+                    old.out_ev.set()
+                    retired = old
+                self._peers.pop(address, None)
+                self._conns.pop(address, None)
+                self._peer_names.pop(address, None)
+                self._out.pop(address, None)
+                self._in.pop(address, None)
+                for key in [k for k in self._proxies if k[0] == address]:
+                    del self._proxies[key]
+        if retired is not None:
+            # Off-lock teardown of the dead incarnation's accessories.
+            if retired.shm_rx is not None:
+                retired.shm_rx.poison()
+                retired.shm_rx.close()
+            if retired.shm_tx is not None:
+                retired.shm_tx.poison()
+                retired.shm_tx.close()
+            retired.shm_rx_ev.set()
+            if retired.decode_lane is not None:
+                retired.decode_lane.close()
         st = self._peer_state(address)
         st.caps = caps
         st.schema_ids = (
@@ -788,6 +843,17 @@ class NodeFabric:
             # Backpressure (rare path): a peer whose writer cannot keep
             # up stalls its senders instead of growing the queue
             # unboundedly.  The writer notifies after each drain.
+            # Surfaced structurally: this is where a saturated REMOTE
+            # mailbox (whose blocked receive thread stalled the TCP
+            # stream) finally reaches the sending application.
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.BACKPRESSURE,
+                    site="writer-queue",
+                    action="wait",
+                    dst=address,
+                    depth=len(st.outq),
+                )
             with st.out_cv:
                 while (
                     len(st.outq) >= self._writer_high_water and not self._closing
@@ -828,8 +894,9 @@ class NodeFabric:
                     # An append raced the clear: keep the event set so a
                     # concurrent sender's skipped set() cannot be lost.
                     st.out_ev.set()
-                elif self._closing or address in self.crashed:
-                    # Node closing, or this peer is terminally dead (no
+                elif self._closing or st.retired or address in self.crashed:
+                    # Node closing, this state superseded by a rejoined
+                    # incarnation, or the peer is terminally dead (no
                     # send path can enqueue for it anymore): exit.
                     return
                 else:
@@ -1493,6 +1560,29 @@ class NodeFabric:
                 return True
             time.sleep(0.002)
         return drained()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Zero-downtime shutdown, step one of a rolling restart:
+
+        1. stop accepting entity placements — the attached cluster (if
+           any) broadcasts its departure and hands every hosted shard
+           off through the grant protocol, journal-checkpointing on
+           the way (``ClusterSharding.drain``);
+        2. flush the per-peer writer queues so every accepted frame
+           reaches the wire.
+
+        After a True return the caller may ``system.terminate()`` and
+        exit; peers lose nothing, and a fresh process on the same
+        address rejoins by simply reconnecting.  False means the
+        timeout expired with residue — the journal (when configured)
+        still covers whatever stayed behind."""
+        drained = True
+        system = self.system
+        cluster = getattr(system, "cluster", None) if system is not None else None
+        if cluster is not None:
+            drained = cluster.drain(timeout_s=timeout_s)
+        flushed = self.flush_writers(timeout_s=min(5.0, timeout_s))
+        return drained and flushed
 
     # ------------------------------------------------------------- #
     # Receive path

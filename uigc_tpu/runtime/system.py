@@ -70,6 +70,15 @@ class ActorSystem:
         self._uid_counter = itertools.count(1)
         self._uid_lock = threading.Lock()
         self.throughput = self.config.get_int("uigc.runtime.throughput")
+        #: bounded-mailbox defaults every cell copies at construction
+        #: (uigc_tpu/runtime/cell.py admission; 0 = unbounded legacy)
+        self.mailbox_limit = self.config.get_int("uigc.runtime.mailbox-limit")
+        self.overflow_policy = self.config.get_string(
+            "uigc.runtime.overflow-policy"
+        )
+        self.mailbox_block_s = (
+            self.config.get_int("uigc.runtime.mailbox-block-ms") / 1000.0
+        )
         #: emit ``sched.*`` scheduling events from the cell layer (for
         #: the race detector, analysis/race.py); read by every cell on
         #: its hot path, so it is a plain attribute, not a config lookup.

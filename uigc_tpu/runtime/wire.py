@@ -463,7 +463,7 @@ def apply_trace_header(msg: Any, header: Any) -> None:
 # ------------------------------------------------------------------- #
 
 #: Frame kinds owned by the cluster layer.
-SHARD_FRAME_KINDS = ("shard", "ent", "mig", "miga", "sgrant")
+SHARD_FRAME_KINDS = ("shard", "ent", "mig", "miga", "sgrant", "sleave")
 
 
 def encode_shard_frame(version: int, origin: str, assignments: dict) -> tuple:
@@ -531,6 +531,25 @@ def decode_shard_grant(frame: tuple):
         shard, origin = frame[1], frame[2]
         return int(shard), str(origin)
     except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_shard_leave(origin: str) -> tuple:
+    """Voluntary departure (the drain lifecycle): ``origin`` asks peers
+    to stop PLACING on it while its links stay up for the handoffs.
+    Unlike a death verdict, holds waiting on the leaver's grants stay
+    armed — the leaver is alive and WILL grant once its handoffs ack."""
+    return ("sleave", origin)
+
+
+def decode_shard_leave(frame: tuple):
+    """-> origin or None."""
+    try:
+        origin = frame[1]
+        if not isinstance(origin, str):
+            return None
+        return origin
+    except (IndexError, TypeError):
         return None
 
 

@@ -430,4 +430,16 @@ def builtin_rules(config: Any) -> List[AlertRule]:
             description="a peer link's phi suspicion crossed half the "
             "death threshold",
         ),
+        AlertRule(
+            "backpressure_spike",
+            "uigc_backpressure_total",
+            "rate",
+            severity="warning",
+            op=">",
+            value=config.get_float("uigc.telemetry.alert-backpressure-rate"),
+            window_s=30.0,
+            description="bounded queues (mailboxes / writer queues / "
+            "cluster buffers) are overflowing faster than the tolerated "
+            "rate — a consumer is saturated or a node is wedged",
+        ),
     ]

@@ -523,6 +523,31 @@ class EventMetricsBridge:
             "Metrics whose labelset count crossed the cardinality bound "
             "(uigc.telemetry.max-labelsets).",
         )
+        self._backpressure = r.counter(
+            "uigc_backpressure_total",
+            "Bounded-queue overflow actions (mailbox / writer-queue / "
+            "cluster buffers), by site and action.",
+        )
+        self._entity_buffer_dropped = r.counter(
+            "uigc_entity_buffer_dropped_total",
+            "Messages shed from capped EntityRef buffers (handoff / "
+            "hold / deferred), by site.",
+        )
+        self._journal_torn = r.counter(
+            "uigc_journal_torn_records_total",
+            "Torn journal frames a recovery scan stopped at "
+            "(cluster/journal.py CRC framing).",
+        )
+        self._journal_recovered = r.counter(
+            "uigc_journal_recovered_total",
+            "Entities reconstructed from the journal (snapshot + "
+            "command replay).",
+        )
+        self._journal_replay_seconds = r.histogram(
+            "uigc_journal_replay_seconds",
+            "Per-entity journal recovery latency (scan + decode + "
+            "replay enqueue).",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -631,6 +656,20 @@ class EventMetricsBridge:
                 )
         elif name == events.LABELSET_OVERFLOW:
             self._labelset_overflows.inc(scope=fields.get("scope", "?"))
+        elif name == events.BACKPRESSURE:
+            self._backpressure.inc(
+                fields.get("count", 1) or 1,
+                site=fields.get("site", "?"),
+                action=fields.get("action", "?"),
+            )
+        elif name == events.SHARD_BUFFER_DROPPED:
+            self._entity_buffer_dropped.inc(site=fields.get("site", "?"))
+        elif name == events.JOURNAL_TORN:
+            self._journal_torn.inc()
+        elif name == events.JOURNAL_RECOVERED:
+            self._journal_recovered.inc()
+            if duration is not None:
+                self._journal_replay_seconds.observe(duration)
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:
@@ -730,6 +769,23 @@ def install_system_gauges(registry: MetricsRegistry, system: Any) -> None:
         "uigc_shard_migrations_pending",
         "Outbound handoffs awaiting their ack.",
         fn=lambda: _cluster_stat(system, "migrations_pending"),
+    )
+    # Durability-plane gauges (cluster/journal.py); sampled only while
+    # a journal is configured — None yields no sample.
+    registry.gauge(
+        "uigc_journal_unsynced_records",
+        "Journal lag: records appended but not yet fsynced.",
+        fn=lambda: _cluster_stat(system, "journal_unsynced"),
+    )
+    registry.gauge(
+        "uigc_journal_live_entities",
+        "Keys the journal is actively tracking on this node.",
+        fn=lambda: _cluster_stat(system, "journal_live_keys"),
+    )
+    registry.gauge(
+        "uigc_journal_segments",
+        "Open + retained journal segment files on this node.",
+        fn=lambda: _cluster_stat(system, "journal_segments"),
     )
 
 

@@ -182,6 +182,41 @@ SHARD_HANDOFF_BUFFERED = "shard.handoff_buffered"
 SHARD_FORWARDED = "shard.forwarded"
 SHARD_STATE_CONFLICT = "shard.state_conflict"
 
+# Durability-plane events (uigc_tpu/cluster/journal.py + the bounded
+# queue admission paths, PR 12):
+#   journal.torn_record     a recovery scan hit a frame whose CRC (or
+#                           framing) failed — the crash tore the tail
+#                           of an append; replay stops cleanly at the
+#                           last valid frame of that segment (fields:
+#                           path, offset)
+#   journal.recovered       one journaled entity was reconstructed
+#                           (snapshot + command replay) after a crash
+#                           or on first touch of a rehomed shard
+#                           (duration_s; fields: key, type, cmds,
+#                           skipped)
+#   fabric.backpressure     a bounded queue refused to grow silently:
+#                           a full mailbox (site="mailbox"), a full
+#                           per-peer writer queue (site="writer-queue")
+#                           or a capped cluster buffer made a sender
+#                           wait, shed the oldest entry, or error
+#                           (fields: site, action="wait"|"shed"|
+#                           "error", depth, path/dst, count)
+#   shard.buffer_dropped    a capped EntityRef buffer (handoff/hold/
+#                           deferred) shed its oldest message (fields:
+#                           site, key, type) — feeds
+#                           uigc_entity_buffer_dropped_total
+#   fabric.node_draining    NodeFabric.drain() began: placements
+#                           stopped, handoffs in flight
+#   fabric.node_drained     the drain finished (fields: complete,
+#                           duration_s) — complete=False means the
+#                           timeout expired with residue
+JOURNAL_TORN = "journal.torn_record"
+JOURNAL_RECOVERED = "journal.recovered"
+BACKPRESSURE = "fabric.backpressure"
+SHARD_BUFFER_DROPPED = "shard.buffer_dropped"
+NODE_DRAINING = "fabric.node_draining"
+NODE_DRAINED = "fabric.node_drained"
+
 # Telemetry self-observation (uigc_tpu/telemetry):
 #   telemetry.listener_error  a recorder listener raised during dispatch;
 #                             fields: listener, event, error.  Counted so
