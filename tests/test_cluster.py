@@ -229,27 +229,42 @@ def test_cluster_frame_round_trip_property():
         assignments = {
             s: f"uigc://n{rng.randrange(4)}" for s in range(rng.randrange(1, 32))
         }
-        shard = wire.encode_shard_frame(version, "uigc://n0", assignments)
+        fence = rng.randrange(4)
+        shard = wire.encode_shard_frame(version, "uigc://n0", assignments, fence)
         assert wire.decode_shard_frame(round_trip(shard)) == (
             version,
             "uigc://n0",
             assignments,
+            fence,
         )
+        # A pre-fencing peer's 4-element frame decodes with fence 0.
+        assert wire.decode_shard_frame(
+            ("shard", version, "uigc://n0", assignments)
+        ) == (version, "uigc://n0", assignments, 0)
         payload = bytes(rng.randrange(256) for _ in range(rng.randrange(64)))
-        ent = wire.encode_entity_frame("counter", f"k{trial}", trial % 9, payload)
+        ent = wire.encode_entity_frame(
+            "counter", f"k{trial}", trial % 9, payload, fence
+        )
         assert wire.decode_entity_frame(round_trip(ent)) == (
             "counter",
             f"k{trial}",
             trial % 9,
             payload,
+            fence,
         )
+        assert wire.decode_entity_frame(
+            ("ent", "counter", f"k{trial}", trial % 9, payload)
+        )[4] == 0
         mig_id = (f"uigc://n{trial % 3}", trial)
-        mig = wire.encode_migration_frame("counter", f"k{trial}", mig_id, payload)
+        mig = wire.encode_migration_frame(
+            "counter", f"k{trial}", mig_id, payload, fence
+        )
         assert wire.decode_migration_frame(round_trip(mig)) == (
             "counter",
             f"k{trial}",
             mig_id,
             payload,
+            fence,
         )
         ack = wire.encode_migration_ack("counter", f"k{trial}", mig_id)
         assert wire.decode_migration_ack(round_trip(ack)) == (

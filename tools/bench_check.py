@@ -94,8 +94,22 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
         [
             Metric("steady.messages_per_sec", "higher", 0.40),
             Metric("restart.p99_latency_s", "lower", 0.60),
+            # r02+: the arbiter's deliberate detection windows (settle
+            # + reconnect probing) are reported separately as
+            # recovery.detection_seconds; this per-entity figure
+            # charges only the machinery after the LAST survivor
+            # verdict, so the band stays a real regression gate even
+            # though the scenario now runs a partition era first.
             Metric("recovery.seconds_per_entity", "lower", 0.60),
             Metric("ledger.lost_acked", "zero", 0.0),
+            # r02+ (--partition): ack p99 through the split-brain +
+            # heal window gets a wide band; dual activation — an
+            # entity sampled live on the quarantined side AND a
+            # survivor — is a hard zero, the fencing plane's whole
+            # point.  Rounds predating the phase lack the keys and
+            # SKIP honestly.
+            Metric("partition.heal_p99_latency_s", "lower", 0.60),
+            Metric("partition.dual_active_keys", "zero", 0.0),
         ],
     ),
     # Device plane (telemetry/device.py + tools/device_report.py): the
@@ -165,6 +179,13 @@ def compare_metric(
     metric: Metric, prior: Optional[float], new: Optional[float]
 ) -> Tuple[str, str]:
     """-> (status, note).  status in PASS/FAIL/SKIP."""
+    if metric.direction == "zero" and new is not None and prior is None:
+        # A correctness tally is an absolute floor, not a trajectory:
+        # its FIRST round must already be zero — a nonzero debut would
+        # otherwise grandfather itself in as the comparison baseline.
+        if new > metric.tolerance:
+            return "FAIL", "nonzero on its first round"
+        return "PASS", "first round"
     if prior is None or new is None:
         return "SKIP", "metric missing in " + (
             "both" if prior is None and new is None

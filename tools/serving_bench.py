@@ -14,16 +14,28 @@ Phases and the figures they print:
    messages/sec plus ack-latency p50/p99;
 2. **restart**  — every data node drained + restarted in sequence with
    traffic still running: p99 ack latency THROUGH the restart window,
-   per-node drain + rejoin wall time;
-3. **crash**    — one node killed abruptly; survivors journal-recover
+   per-node drain + rejoin wall time (runs BEFORE the partition phase
+   so its figures stay comparable with the r01 trajectory);
+3. **partition** (``--partition``) — a symmetric partition isolates one
+   (already once-restarted) node for >= 10 heartbeat windows
+   mid-traffic: the split-brain resolver downs the minority
+   (quarantine: entities drained to the journal, append plane frozen),
+   the majority absorbs its shards and keeps serving, then the link
+   heals and the ``mship`` handshake readmits the loser.  Figures:
+   verdict/heal wall time, ack p99 through the partition+heal window,
+   the sampled count of entities concurrently active on two nodes
+   (hard zero), and the fence counters (stale appends refused,
+   recovery conflicts quarantined);
+4. **crash**    — one node killed abruptly; survivors journal-recover
    its sessions: recovery seconds and seconds-per-entity;
-4. **ledger**   — per-session floor check: ``lost_acked`` must be 0.
+5. **ledger**   — per-session floor check: ``lost_acked`` must be 0.
 
 Prints one JSON object; commit as ``BENCH_SCENARIO_r{N}.json``
-(bench_check's SCENARIO family gates messages_per_sec, restart p99 and
-lost_acked across rounds).
+(bench_check's SCENARIO family gates messages_per_sec, restart p99,
+lost_acked, heal p99 and the dual-activation hard zero across rounds).
 
-Usage: python tools/serving_bench.py [--sessions 300] [--seconds 4] [--smoke]
+Usage: python tools/serving_bench.py [--sessions 300] [--seconds 4]
+       [--partition] [--smoke]
 """
 
 from __future__ import annotations
@@ -46,8 +58,8 @@ from uigc_tpu.utils import events  # noqa: E402
 from uigc_tpu.utils.validation import require  # noqa: E402
 
 
-def base_config(journal_dir: str) -> dict:
-    return {
+def base_config(journal_dir: str, partition: bool = False) -> dict:
+    config = {
         "uigc.crgc.wakeup-interval": 50,
         "uigc.crgc.egress-finalize-interval": 10,
         "uigc.crgc.shadow-graph": "array",
@@ -70,6 +82,31 @@ def base_config(journal_dir: str) -> dict:
         "uigc.node.max-batch-frames": 1024,
         "uigc.node.writer-queue-limit": 32768,
     }
+    if partition:
+        # Partition detection needs the heartbeat plane (a cut produces
+        # silence, never EOF) and the split-brain resolver on its
+        # default keep-majority strategy.  The detector is deliberately
+        # LENIENT (default threshold, a generous pause): the post-heal
+        # rebalance floods the regained shards, and block-policy
+        # backpressure can stall a RECEIVE thread long enough that
+        # arriving heartbeats sit unrecorded in the kernel buffer — a
+        # tight pause reads that as death and cascades into spurious
+        # splits.  Reconnect retries are the second line: even a false
+        # verdict then self-heals through the same heal-rejoin +
+        # handshake machinery a real partition uses, instead of
+        # leaving a permanently dark link nobody re-dials.
+        config.update(
+            {
+                "uigc.node.heartbeat-interval": 50,
+                "uigc.node.phi-threshold": 8.0,
+                "uigc.node.heartbeat-pause": 2500,
+                "uigc.node.reconnect-retries": 4,
+                "uigc.node.reconnect-backoff": 100,
+                "uigc.cluster.sbr-strategy": "keep-majority",
+                "uigc.cluster.sbr-settle": 300,
+            }
+        )
+    return config
 
 
 class ChatSession(Entity):
@@ -149,9 +186,9 @@ def percentile(samples, p):
 class Node:
     __slots__ = ("name", "fabric", "system", "cluster", "region", "port")
 
-    def __init__(self, name: str, config: dict):
+    def __init__(self, name: str, config: dict, plan=None):
         self.name = name
-        self.fabric = NodeFabric()
+        self.fabric = NodeFabric(fault_plan=plan)
         self.system = ActorSystem(None, name=name, config=config, fabric=self.fabric)
         self.port = self.fabric.listen()
         self.cluster = ClusterSharding.attach(self.system)
@@ -167,17 +204,29 @@ def settle(predicate, timeout_s=60.0):
     return predicate()
 
 
-def run(n_sessions: int, phase_seconds: float) -> dict:
+def run(n_sessions: int, phase_seconds: float, partition: bool = False) -> dict:
     journal_dir = tempfile.mkdtemp(prefix="uigc-serving-journal-")
     recovered = []
+
+    verdicts = []
 
     def listener(name, fields):
         if name == events.JOURNAL_RECOVERED:
             recovered.append(fields)
+        elif name == events.SBR_DECISION:
+            # the instant a settled membership verdict executed
+            # (listeners run synchronously on the committing thread)
+            verdicts.append((time.perf_counter(), fields))
 
-    config = base_config(journal_dir)
+    plan = None
+    if partition:
+        from uigc_tpu.runtime.faults import FaultPlan
+
+        plan = FaultPlan(2026)
+    config = base_config(journal_dir, partition=partition)
     nodes = {
-        name: Node(name, config) for name in ("serve-a", "serve-b", "serve-c")
+        name: Node(name, config, plan)
+        for name in ("serve-a", "serve-b", "serve-c")
     }
     a = nodes["serve-a"]
     result = {"sessions": n_sessions, "journal_dir": journal_dir}
@@ -243,9 +292,10 @@ def run(n_sessions: int, phase_seconds: float) -> dict:
             "ack_samples": len(lat),
         }
 
-        # -- phase 2: rolling restart under traffic ----------------- #
         events.recorder.enable()
         events.recorder.add_listener(listener)
+
+        # -- phase 2: rolling restart under traffic ----------------- #
         restart_stats = []
         window_lat = []
         for name in ("serve-b", "serve-c"):
@@ -263,7 +313,7 @@ def run(n_sessions: int, phase_seconds: float) -> dict:
                 f"{name} never left the member set",
             )
             t_join = time.perf_counter()
-            fresh = Node(name, config)
+            fresh = Node(name, config, plan)
             nodes[name] = fresh
             fresh.fabric.connect("127.0.0.1", a.port)
             for other_name, other in nodes.items():
@@ -299,11 +349,135 @@ def run(n_sessions: int, phase_seconds: float) -> dict:
             "ack_samples": len(window_lat),
         }
 
-        # -- phase 3: abrupt kill + journal recovery ---------------- #
+        # -- phase 3 (--partition): split-brain + heal under traffic - #
+        if partition:
+            b = nodes["serve-b"]
+            c = nodes["serve-c"]
+            hb_s = config["uigc.node.heartbeat-interval"] / 1000.0
+            doomed_b = sum(
+                1 for k in keys if a.cluster.home_of(k) == b.system.address
+            )
+            ledger.take_latencies()
+            t_cut = time.perf_counter()
+            plan.isolate(b.system.address)
+            require(
+                settle(
+                    lambda: b.system.address not in a.cluster.members()
+                    and b.system.address not in c.cluster.members()
+                    and b.cluster.quarantined,
+                    60.0,
+                ),
+                "bench.partition-verdict",
+                "split-brain verdicts never settled",
+            )
+            verdict_s = time.perf_counter() - t_cut
+            require(
+                settle(
+                    lambda: b.region.active_count() == 0
+                    and b.cluster.journal.frozen,
+                    30.0,
+                ),
+                "bench.quarantine",
+                "minority never finished its quarantine drain",
+            )
+            # Majority absorbed the minority's shards and keeps serving.
+            require(
+                settle(
+                    lambda: a.cluster.migrations.pending_count() == 0
+                    and c.cluster.migrations.pending_count() == 0,
+                    60.0,
+                ),
+                "bench.partition-absorb",
+                "majority never absorbed the minority's shards",
+            )
+            # Keep the cut open for >= 10 heartbeat windows in total,
+            # sampling for dual activation the whole time: a key active
+            # on the quarantined side AND a survivor is the divergence
+            # the fencing plane exists to make impossible.
+            dual_active = 0
+            deadline = t_cut + max(10 * hb_s, verdict_s) + 0.5
+            while time.perf_counter() < deadline:
+                quarantined_keys = set(b.region.active_keys())
+                for survivor in (a, c):
+                    dual_active = max(
+                        dual_active,
+                        len(
+                            quarantined_keys
+                            & set(survivor.region.active_keys())
+                        ),
+                    )
+                time.sleep(0.05)
+            partition_window_s = time.perf_counter() - t_cut
+            fence_rejected_appends = b.cluster.journal.stats()[
+                "fence_rejected_appends"
+            ]
+            # -- heal: mend the links, handshake, readmit ----------- #
+            t_heal = time.perf_counter()
+            plan.heal(b.system.address, "*")
+            b.fabric.connect("127.0.0.1", a.port)
+            b.fabric.connect("127.0.0.1", c.port)
+            require(
+                settle(
+                    lambda: not b.cluster.quarantined
+                    and all(
+                        len(n.cluster.members()) == 3 for n in nodes.values()
+                    )
+                    and all(
+                        n.cluster.migrations.pending_count() == 0
+                        for n in nodes.values()
+                    ),
+                    60.0,
+                ),
+                "bench.heal",
+                "the partitioned node never rejoined after the heal",
+                quarantined=b.cluster.quarantined,
+                members={
+                    n.name: n.cluster.members() for n in nodes.values()
+                },
+                pending={
+                    n.name: n.cluster.migrations.pending_count()
+                    for n in nodes.values()
+                },
+                fabric_members={
+                    n.name: n.fabric.members() for n in nodes.values()
+                },
+                fabric_crashed={
+                    n.name: sorted(n.fabric.crashed) for n in nodes.values()
+                },
+            )
+            heal_s = time.perf_counter() - t_heal
+            heal_lat = ledger.take_latencies()
+            bookkeeper = b.system.engine.bookkeeper
+            result["partition"] = {
+                "victim": b.name,
+                "verdict_seconds": verdict_s,
+                "partition_window_s": partition_window_s,
+                "heartbeat_windows": partition_window_s / hb_s,
+                "dual_active_keys": dual_active,
+                "fence_rejected_appends": fence_rejected_appends,
+                "fence_conflicts_quarantined": sum(
+                    n.cluster.journal.stats()["fence_conflicts"]
+                    for n in nodes.values()
+                ),
+                "sessions_homed_on_victim": doomed_b,
+                "heal_seconds": heal_s,
+                "heal_p99_latency_s": percentile(heal_lat, 99),
+                "heal_p50_latency_s": percentile(heal_lat, 50),
+                "ack_samples": len(heal_lat),
+                "rejoined_collector_clean": int(
+                    not bookkeeper.downed_gcs and not b.cluster.quarantined
+                ),
+                "cluster_fence": a.cluster.current_fence,
+            }
+
+
+        # -- phase 4: abrupt kill + journal recovery ---------------- #
         victim = nodes["serve-c"]
         doomed = sum(
             1 for k in keys if a.cluster.home_of(k) == victim.system.address
         )
+        base_recovered = len(recovered)  # partition/restart phases recover too
+        base_verdicts = len(verdicts)
         t_crash = time.perf_counter()
         victim.fabric.die()
         require(
@@ -314,26 +488,44 @@ def run(n_sessions: int, phase_seconds: float) -> dict:
             "victim never declared dead",
         )
         require(
-            settle(lambda: len(recovered) >= doomed, 60.0),
+            settle(lambda: len(recovered) - base_recovered >= doomed, 60.0),
             "bench.recovery",
             "journal recovery never covered the victim's sessions",
-            recovered=len(recovered),
+            recovered=len(recovered) - base_recovered,
             doomed=doomed,
         )
         recovery_s = time.perf_counter() - t_crash
         stop.set()
         thread.join(timeout=5)
+        crash_recovered = recovered[base_recovered:]
+        # With the arbiter on (the default), the membership verdict is
+        # DELIBERATELY deferred by the sbr-settle window (plus any
+        # reconnect probing); detection is that wait, recovery is the
+        # machinery after it.  Split the two: ``seconds`` stays the
+        # full user-visible outage (crash -> every session recovered),
+        # ``seconds_per_entity`` charges the recovery plane only for
+        # the part it controls — otherwise the fixed detection
+        # windows, divided by the session count, would read as a
+        # per-entity replay regression.  The LAST survivor verdict is
+        # the start line: the victim's shards split across survivors,
+        # and no inheritor can recover before its own verdict.
+        crash_verdicts = verdicts[base_verdicts:]
+        t_verdict = (
+            max(t for t, _f in crash_verdicts) if crash_verdicts else t_crash
+        )
+        machinery_s = max(0.0, (t_crash + recovery_s) - t_verdict)
         result["recovery"] = {
-            "entities": len(recovered),
+            "entities": len(crash_recovered),
             "seconds": recovery_s,
-            "seconds_per_entity": recovery_s / max(1, len(recovered)),
+            "detection_seconds": max(0.0, t_verdict - t_crash),
+            "seconds_per_entity": machinery_s / max(1, len(crash_recovered)),
             "replay_s_mean": (
-                sum(f.get("duration_s") or 0.0 for f in recovered)
-                / max(1, len(recovered))
+                sum(f.get("duration_s") or 0.0 for f in crash_recovered)
+                / max(1, len(crash_recovered))
             ),
         }
 
-        # -- phase 4: ledger verification --------------------------- #
+        # -- phase 5: ledger verification --------------------------- #
         survivors = [n for n in nodes.values() if n is not victim]
         deadline = time.monotonic() + 60.0
         lost = keys
@@ -385,12 +577,19 @@ def main() -> int:
         "--seconds", type=float, default=4.0, help="steady-phase duration"
     )
     parser.add_argument(
+        "--partition",
+        action="store_true",
+        help="add the split-brain phase: partition one node mid-run "
+        "(>= 10 heartbeat windows), verify quarantine + single-side "
+        "serving, heal, and gate the ledger across it",
+    )
+    parser.add_argument(
         "--smoke", action="store_true", help="quick gate (60 sessions, 1s)"
     )
     args = parser.parse_args()
     if args.smoke:
         args.sessions, args.seconds = 60, 1.0
-    result = run(args.sessions, args.seconds)
+    result = run(args.sessions, args.seconds, partition=args.partition)
     print(json.dumps(result, indent=2))
     return 0
 

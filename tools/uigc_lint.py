@@ -161,6 +161,8 @@ RULES = {
     "UL011": "unannotated device->host transfer on an engines/ops hot path",
     "UL012": "unbounded queue-shaped attribute in runtime//cluster/ "
     "without a bound or an '# unbounded:' rationale",
+    "UL013": "journal append or shard-table mutation bypassing the "
+    "fenced helpers in cluster/sharding.py / cluster/journal.py",
 }
 
 #: UL012: attribute names that read as queues/buffers.  The rule fires
@@ -178,6 +180,19 @@ _NUMPY_QUALS = {"np", "numpy", "_np"}
 
 #: UL010: the pickle entry points that bypass the schema codec.
 _PICKLE_CALLS = {"dumps", "loads", "dump", "load", "Pickler", "Unpickler"}
+
+#: UL013: the journal's append-plane entry points.  Outside the fenced
+#: helper modules (cluster/sharding.py drives them under the region
+#: lock with the epoch/fence discipline; cluster/journal.py is the
+#: implementation) a direct call bypasses fence stamping, the
+#: frozen-journal reject site, and the epoch-bump-at-enqueue ordering —
+#: the dual-activation door PR 13 closed.
+_JOURNAL_APPEND_CALLS = {
+    "open_epoch",
+    "note_command",
+    "commit_snapshot",
+    "begin_snapshot",
+}
 
 #: UL009: unit suffixes a counter or histogram name must end with.
 _METRIC_UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio")
@@ -345,6 +360,10 @@ class _FileLinter:
         pickle_guarded = in_runtime and not norm.endswith("runtime/wire.py")
         device_plane = bool({"engines", "ops", "parallel"} & set(parts))
         bounded_plane = in_runtime or "cluster" in parts
+        fence_plane = bounded_plane and not (
+            norm.endswith("cluster/sharding.py")
+            or norm.endswith("cluster/journal.py")
+        )
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
@@ -355,11 +374,16 @@ class _FileLinter:
                     self._lint_pickle_hot_path(node)
                 if device_plane:
                     self._lint_host_transfer(node)
+                if fence_plane:
+                    self._lint_fenced_journal(node)
                 self._lint_metric_name(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
-            elif isinstance(node, (ast.Assign, ast.AnnAssign)) and bounded_plane:
-                self._lint_unbounded_queue(node)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                if bounded_plane:
+                    self._lint_unbounded_queue(node)
+                if fence_plane:
+                    self._lint_table_mutation(node)
         if self.path.replace(os.sep, "/").endswith("telemetry/inspect.py"):
             self._lint_inspect_readonly()
         if lint_asserts:
@@ -579,6 +603,44 @@ class _FileLinter:
                 "route through arrays._readback or annotate the line "
                 "with '# readback: <why>'",
             )
+
+    def _lint_fenced_journal(self, node: ast.Call) -> None:
+        """UL013 (call half): the journal append plane may only be
+        driven through the fenced region helpers — a direct
+        ``open_epoch``/``note_command``/``commit_snapshot``/
+        ``begin_snapshot`` call anywhere else in runtime//cluster/
+        bypasses fence stamping, the frozen-journal reject site and the
+        epoch-bump-at-enqueue ordering."""
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _JOURNAL_APPEND_CALLS
+        ):
+            self.add(
+                node.lineno,
+                "UL013",
+                f"direct journal append '{func.attr}(...)' outside the "
+                "fenced helpers (route through the ShardRegion "
+                "_journal_* helpers in cluster/sharding.py)",
+            )
+
+    def _lint_table_mutation(self, node: ast.AST) -> None:
+        """UL013 (store half): the shard table is installed only by
+        cluster/sharding.py's fence-aware transitions
+        (``_recompute_table``/``_adopt_table``); any other
+        ``<x>._table = ...`` store skips the fence comparison and the
+        grant/hold bookkeeping."""
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for target in targets:
+            if isinstance(target, ast.Attribute) and target.attr == "_table":
+                self.add(
+                    node.lineno,
+                    "UL013",
+                    "shard-table store bypasses the fenced transition "
+                    "helpers in cluster/sharding.py",
+                )
 
     def _lint_unbounded_queue(self, node: ast.AST) -> None:
         """UL012: queue-shaped attributes in runtime//cluster/ must be

@@ -59,6 +59,7 @@ KEY_SERIES: Tuple[Tuple[str, str, str], ...] = (
     ("uigc_writer_queue_depth", "writer queue", "max"),
     ("uigc_send_matrix_pairs", "send pairs", "last"),
     ("uigc_leak_suspects_total", "leak suspects", "last"),
+    ("uigc_fence_rejected_total", "fence rejects/s", "rate"),
 )
 
 #: header gauges pulled from /metrics.json: (metric, short label)
@@ -352,6 +353,28 @@ def render_dashboard(
                 f"  {peer:<28} phi {fmt_si(phi):>7}  "
                 f"queue {fmt_si(health.get('queue')):>7}  [{state}]"
             )
+    # Partition-tolerance counters (cluster/membership.py): totals per
+    # metric, summed over labelsets — nonzero means the split-brain
+    # plane acted (or is refusing stale work) on this node.
+    sbr_cells = []
+    for metric, label in (
+        ("uigc_cluster_partitions_total", "partitions"),
+        ("uigc_sbr_downed_total", "sbr-downed"),
+        ("uigc_fence_rejected_total", "fence-rejected"),
+        ("uigc_membership_disagreements_total", "view-conflicts"),
+    ):
+        total = 0.0
+        seen_any = False
+        for s in _find_series({"series": series_list}, metric):
+            pts = series_points(s, "last")
+            if pts:
+                seen_any = True
+                total += pts[-1][1]
+        if seen_any and total > 0:
+            sbr_cells.append(f"{label} {fmt_si(total)}")
+    if sbr_cells:
+        lines.append("")
+        lines.append("partition plane: " + "  ".join(sbr_cells))
     lines.append("")
     lines.extend(render_device_panel(device))
     firing = (alerts or {}).get("firing", [])

@@ -291,7 +291,7 @@ class Sanitizer:
         """Observe the collector's control-plane stream for the monotone
         sequence invariant on peer delta gossip."""
         from ..engines.crgc.collector import DeltaMsg
-        from ..runtime.fabric import MemberRemoved
+        from ..runtime.fabric import MemberRemoved, MemberUp
 
         orig = bookkeeper.on_message
 
@@ -301,6 +301,16 @@ class Sanitizer:
                 # its gossip sequence from zero — the monotonicity
                 # window is per incarnation, not per address.
                 with self._lock:
+                    self._delta_seq.pop(msg.address, None)
+            if isinstance(msg, MemberUp):
+                # Re-admission of a previously-downed address (restart
+                # rejoin, or a heal after a partition verdict): the
+                # collector reset its undo state, so a LATER legitimate
+                # fold for this address must not read as a double fold
+                # — and the healed peer's delta stream continues its
+                # own numbering, so the window re-learns from scratch.
+                with self._lock:
+                    self._folded_undo.discard(msg.address)
                     self._delta_seq.pop(msg.address, None)
             if isinstance(msg, DeltaMsg) and msg.graph.address is not None:
                 addr = msg.graph.address

@@ -11,6 +11,7 @@ in-memory store and recreate on the next send.
 """
 
 from .journal import EntityJournal
+from .membership import MembershipArbiter, SbrDecision
 from .migration import MigrationManager, translate_refs
 from .passivation import PassivationPolicy, StateStore
 from .sharding import (
@@ -28,7 +29,9 @@ __all__ = [
     "Entity",
     "EntityJournal",
     "EntityRef",
+    "MembershipArbiter",
     "MigrationManager",
+    "SbrDecision",
     "PassivationPolicy",
     "ShardRegion",
     "ShardTable",

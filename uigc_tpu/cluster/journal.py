@@ -54,6 +54,25 @@ re-snapshotted (the region drives this from the cluster tick), and a
 segment every one of whose records is superseded by a newer epoch in a
 newer segment is deleted.  Only a node's OWN segments are ever deleted
 — a dead peer's files are someone's recovery source, never garbage.
+
+Fenced epochs (PR 13): every record additionally carries the writer's
+**fence** — the partition era minted by the membership arbiter
+(cluster/membership.py).  Within one fence the hybrid-logical epochs
+order activations exactly as before; ACROSS fences the wall clock can
+no longer be trusted (a partitioned minority keeps appending under
+fresh wall-ms epochs while the majority, which bumped its fence on the
+split-brain verdict, opens its own).  Recovery therefore resolves per
+key: the highest fence wins, and any lower-fence record whose epoch
+claims to supersede the high-fence base is a **conflict** — counted,
+reported (``cluster.fence_rejected`` site="recovery") and QUARANTINED
+out of the replay, never silently merged.  Lower-fence records that
+predate the high-fence base (ordinary history the survivor's
+activation already saw) replay normally, which is what lets a healed
+minority's non-conflicting journal suffix survive the merge.  A node
+the arbiter downs has its append plane **frozen**: post-verdict
+command appends are refused at the append site
+(``uigc_fence_rejected_total{site="journal"}``), so zero fenced-stale
+appends can reach a recovery merge.
 """
 
 from __future__ import annotations
@@ -203,17 +222,37 @@ class EntityJournal:
         #: lazily loaded per-shard recovery indexes; invalidated on
         #: membership change (a peer's files may have grown)
         self._recover_cache: Dict[Tuple[str, int], Dict[str, list]] = {}
+        #: file-granular parse cache underneath the shard index:
+        #: path -> ((size, mtime_ns), parsed records).  Shard-index
+        #: invalidation is cheap-by-design (membership changes clear it
+        #: wholesale), so without this layer every invalidation
+        #: re-parsed EVERY segment of every shard — after a partition
+        #: era's extra segments that rescan dominated recovery time
+        #: (the per-shard-scan cost ROADMAP item 4 names).  Append-only
+        #: files revalidate with one stat: same size+mtime = same
+        #: records.
+        self._file_cache: Dict[str, Tuple[tuple, list]] = {}
         #: (type, shard, key) sets due a re-snapshot after a roll
         self._resnap_due: Set[Tuple[str, int, str]] = set()
         #: the torn-append injection (or a real I/O error) killed the
         #: append plane — everything after the tear is lost, as it
         #: would be in the crashed process this simulates
         self._dead = False
+        #: current partition era, stamped on every record (the arbiter
+        #: updates it; 0 = the pre-fencing era)
+        self.fence = 0
+        #: the arbiter downed this node: command appends are refused
+        #: until a heal-time rejoin unfreezes under the new fence
+        self._frozen = False
         # counters for gauges/stats
         self.appended_records = 0
         self.appended_bytes = 0
         self.recovered_entities = 0
         self.torn_records = 0
+        #: lower-fence records quarantined out of recovery merges
+        self.fence_conflicts = 0
+        #: appends refused while frozen (the stale-owner reject site)
+        self.fence_rejected_appends = 0
 
     # ------------------------------------------------------------- #
     # Append plane
@@ -256,8 +295,23 @@ class EntityJournal:
         """Caller holds ``self._lock``."""
         if self._dead:
             return
+        if self._frozen:
+            # Fenced-stale append: the arbiter downed this node, so its
+            # writes must never reach a recovery merge.  Refused HERE —
+            # at the append site — not discovered later by the merge.
+            self.fence_rejected_appends += 1
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.FENCE_REJECTED,
+                    site="journal",
+                    key=key,
+                    type=type_name,
+                    fence=self.fence,
+                )
+            return
         payload = pickle.dumps(
-            (key, epoch, seq, kind, blob), protocol=pickle.HIGHEST_PROTOCOL
+            (key, epoch, seq, kind, blob, self.fence),
+            protocol=pickle.HIGHEST_PROTOCOL,
         )
         frame = _frame_record(payload)
         writer = self._writer(type_name, shard)
@@ -298,7 +352,7 @@ class EntityJournal:
         cached = self._recover_cache.get((type_name, shard))
         if cached is not None:
             records = cached.setdefault(key, [])
-            records.append((epoch, seq, kind, blob))
+            records.append((epoch, seq, kind, blob, self.fence, self.node_safe))
             if len(records) > 1 and records[-2][:2] > (epoch, seq):
                 records.sort(key=lambda r: (r[0], r[1]))
         self.appended_records += 1
@@ -455,6 +509,32 @@ class EntityJournal:
             writer = self._writer(type_name, shard)
             self._live[(type_name, key)] = [known, seq, shard, writer.segment]
 
+    def set_fence(self, fence: int) -> None:
+        """Adopt a (higher) partition era; stamped on every later
+        record.  Monotone — a stale adoption is ignored."""
+        with self._lock:
+            if fence > self.fence:
+                self.fence = fence
+
+    def freeze(self) -> None:
+        """The arbiter downed this node: refuse every later append
+        (counted + reported per attempt).  The quarantine drain's final
+        snapshots land BEFORE the freeze — the region sequences it."""
+        with self._lock:
+            self._frozen = True
+
+    def unfreeze(self, fence: int) -> None:
+        """Heal-time rejoin: adopt the survivor's fence and resume the
+        append plane under it."""
+        with self._lock:
+            self._frozen = False
+            if fence > self.fence:
+                self.fence = fence
+
+    @property
+    def frozen(self) -> bool:
+        return self._frozen
+
     def forget(self, type_name: str, key: str) -> None:
         """The key left this node (migrated away / shipped): stop
         tracking it.  Its records remain — superseded by the new
@@ -564,6 +644,27 @@ class EntityJournal:
                 continue
         return sorted(out)
 
+    def _scan_file_cached(self, path: str) -> List[tuple]:
+        """Parsed records of one segment file, revalidated by stat:
+        an unchanged (size, mtime_ns) on an append-only file means the
+        parse is current.  A vanished file (compacted away) drops its
+        entry."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            with self._lock:
+                self._file_cache.pop(path, None)
+            return []
+        stamp = (st.st_size, st.st_mtime_ns)
+        with self._lock:
+            cached = self._file_cache.get(path)
+            if cached is not None and cached[0] == stamp:
+                return cached[1]
+        records = self._scan_file(path)
+        with self._lock:
+            self._file_cache[path] = (stamp, records)
+        return records
+
     def _scan_file(self, path: str) -> List[tuple]:
         """All valid records of one segment file, stopping cleanly at
         the first torn frame."""
@@ -587,11 +688,14 @@ class EntityJournal:
                 return records
             try:
                 record = pickle.loads(data[body_start : body_start + length])
-                key, epoch, seq, kind, blob = record
+                # 5-tuple = pre-fencing era (fence 0); 6-tuple carries
+                # the writer's fence.  Tolerant both directions.
+                key, epoch, seq, kind, blob = record[:5]
+                fence = int(record[5]) if len(record) > 5 else 0
             except Exception:
                 self._report_torn(path, pos)
                 return records
-            records.append((str(key), int(epoch), int(seq), kind, blob))
+            records.append((str(key), int(epoch), int(seq), kind, blob, fence))
             pos = body_start + length
         if pos != size:
             self._report_torn(path, pos)
@@ -619,12 +723,36 @@ class EntityJournal:
             names = sorted(n for n in os.listdir(dirpath) if n.endswith(".uj"))
         except OSError:
             names = []
+        # Evict parse-cache entries for segments compaction deleted —
+        # they are no longer listed, so the stat-side eviction in
+        # _scan_file_cached never sees them, and each would otherwise
+        # pin its full parsed record list forever.
+        live = {os.path.join(dirpath, name) for name in names}
+        prefix = dirpath + os.sep
+        with self._lock:
+            for path in [
+                p
+                for p in self._file_cache
+                if p.startswith(prefix) and p not in live
+            ]:
+                del self._file_cache[path]
         by_key: Dict[str, list] = {}
         for name in names:
-            for key, epoch, seq, kind, blob in self._scan_file(
+            # The segment filename carries the WRITER node — the merge
+            # needs it to tell a same-writer epoch continuing across a
+            # fence adoption from two writers colliding on one
+            # wall-clock epoch behind a partition.
+            # rsplit: the segment name is '<node_safe>.<NNNNN>.uj' and
+            # node_safe may itself contain dots ('10.0.0.5' survives
+            # _safe_component) — splitting from the LEFT would truncate
+            # such prefixes and alias distinct writers.
+            writer = name.rsplit(".", 2)[0]
+            for key, epoch, seq, kind, blob, fence in self._scan_file_cached(
                 os.path.join(dirpath, name)
             ):
-                by_key.setdefault(key, []).append((epoch, seq, kind, blob))
+                by_key.setdefault(key, []).append(
+                    (epoch, seq, kind, blob, fence, writer)
+                )
         for records in by_key.values():
             records.sort(key=lambda r: (r[0], r[1]))
         with self._lock:
@@ -647,12 +775,76 @@ class EntityJournal:
         """(state_blob, [command_blobs]) for the key, or None when the
         journal holds nothing for it.  Base = the LAST snapshot record;
         every later command (same epoch seq>0, plus commands of newer
-        epochs whose snapshot never landed) replays on top."""
+        epochs whose snapshot never landed) replays on top.
+
+        Fence resolution: when the key's records span more than one
+        partition era, the highest fence is authoritative.  A survivor's
+        activation opens a FRESH epoch (hybrid-logical ``known+1``)
+        that strictly exceeds every lower-fence epoch it could SEE, so
+        any lower-fence record whose epoch reaches that fresh base was
+        written concurrently behind the partition — dual activation.
+        Those records are QUARANTINED out of the replay (counted +
+        reported), never merged; lower-fence history below the base
+        replays normally, which is exactly the healed minority's
+        non-conflicting suffix surviving.
+
+        An epoch with records at BOTH fences FROM THE SAME WRITER is
+        something else entirely: that incarnation kept journaling
+        across a fence adoption (a survivor's live entity at the
+        verdict — set_fence changes the stamp, not the epoch).  Such
+        continuation epochs anchor no conflict and are never
+        conflicting themselves — without the carve-out a survivor's
+        own pre-verdict snapshot would read as 'stale era at the base
+        epoch' and be quarantined, silently losing acked state.  The
+        writer identity matters: two DIFFERENT writers landing on one
+        wall-clock epoch across the fence split (the quarantine drain
+        and the survivor's activation inside the same millisecond) is
+        dual activation, not continuation."""
         cache = self._load_shard(type_name, shard)
         with self._lock:
             records = list(cache.get(key) or ())
         if not records:
             return None
+        max_fence = max(r[4] for r in records)
+        if max_fence > min(r[4] for r in records):
+            low_pairs = {(r[5], r[0]) for r in records if r[4] < max_fence}
+            high_pairs = {(r[5], r[0]) for r in records if r[4] == max_fence}
+            # Continuation is a (writer, epoch) property: only the
+            # SAME writer's lower-fence records in a shared epoch are
+            # the pre-adoption half of one incarnation.  A DIFFERENT
+            # writer landing in that epoch at a lower fence wrote
+            # behind the partition — conflict, exactly what the
+            # carve-out must not excuse.
+            continuation = low_pairs & high_pairs
+            # The base anchor is the min epoch seen at the top fence,
+            # continuation or fresh: the top-fence writer was live in
+            # that epoch through the verdict, so any OTHER writer's
+            # lower-fence record at or past it is concurrent-behind-
+            # the-partition even when no fresh activation ever opened.
+            fence_base_epoch = min(e for (_w, e) in high_pairs)
+            conflicting = [
+                r
+                for r in records
+                if r[4] < max_fence
+                and r[0] >= fence_base_epoch
+                and (r[5], r[0]) not in continuation
+            ]
+            if conflicting:
+                dropped = set(conflicting)
+                records = [r for r in records if r not in dropped]
+                with self._lock:
+                    self.fence_conflicts += len(conflicting)
+                if events.recorder.enabled:
+                    events.recorder.commit(
+                        events.FENCE_REJECTED,
+                        site="recovery",
+                        key=key,
+                        type=type_name,
+                        count=len(conflicting),
+                        max_fence=max_fence,
+                    )
+            if not records:
+                return None
         base_idx = None
         for i in range(len(records) - 1, -1, -1):
             if records[i][2] == _SNAP:
@@ -681,6 +873,10 @@ class EntityJournal:
                 "recovered_entities": self.recovered_entities,
                 "torn_records": self.torn_records,
                 "dead": self._dead,
+                "fence": self.fence,
+                "frozen": self._frozen,
+                "fence_conflicts": self.fence_conflicts,
+                "fence_rejected_appends": self.fence_rejected_appends,
             }
 
     # -- internals ------------------------------------------------- #

@@ -259,8 +259,16 @@ class MigrationManager:
         home = cluster.home_of(mig.key)
         if home is None:
             return  # membership vacuum: the retry timer re-resolves
+        # Fence-stamped at SEND time (not capture time): a handoff that
+        # survives a heal re-ships under the ADOPTED era and becomes
+        # acceptable again — only a sender still living in a superseded
+        # era is refused.
         frame = wire.encode_migration_frame(
-            mig.region.type_name, mig.key, mig.mig_id, mig.blob
+            mig.region.type_name,
+            mig.key,
+            mig.mig_id,
+            mig.blob,
+            cluster.current_fence,
         )
         if home == cluster.address:
             # The table swung back to us (the target died mid-handoff):
@@ -339,9 +347,26 @@ class MigrationManager:
         decoded = wire.decode_migration_frame(frame)
         if decoded is None:
             return
-        type_name, key, mig_id, blob = decoded
+        type_name, key, mig_id, blob, fence = decoded
         mig_id = tuple(mig_id)
         cluster = self.cluster
+        if cluster._quarantined:
+            return  # not serving: no ack, the sender re-resolves
+        if fence < cluster.current_fence:
+            # State shipped under a superseded partition era — a stale
+            # owner's post-partition copy.  Refused, never merged: no
+            # ack and no dedup entry, so a sender that heals and adopts
+            # the current fence gets a full fresh attempt.
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.FENCE_REJECTED,
+                    site="mig",
+                    key=key,
+                    type=type_name,
+                    src=from_address,
+                    fence=fence,
+                )
+            return
         region = cluster._regions.get(type_name)
         if region is None:
             return  # type not started here; sender keeps retrying

@@ -65,9 +65,16 @@ if TYPE_CHECKING:  # pragma: no cover
 _ACTIVE = "active"
 _HANDOFF = "handoff"
 _PASSIVATING = "passivating"
+#: losing side of a split-brain verdict: the entity is draining its
+#: state to the journal and stopping (cluster/membership.py)
+_QUARANTINING = "quarantining"
 
 #: sentinel distinguishing "shard not held" from "held awaiting any grant"
 _NOT_HELD = object()
+
+#: sentinel for a quarantine capture whose snapshot_state() raised —
+#: distinct from a legitimate None state (see _QuarantineCmd.apply)
+_SNAPSHOT_FAILED = object()
 
 
 class _GrantWatch:
@@ -124,19 +131,31 @@ class ShardTable:
     """A versioned shard->address assignment.  Versions totally order
     table adoptions across the cluster: (version, origin) is a lamport
     pair, so two nodes that recompute concurrently converge on one
-    winner even before their membership views agree."""
+    winner even before their membership views agree.  The fence epoch
+    (cluster/membership.py) orders tables ACROSS partition eras before
+    the lamport pair: a quarantined minority's table — whatever its
+    version counter says — can never supersede a survivor's."""
 
-    __slots__ = ("version", "origin", "assignments")
+    __slots__ = ("version", "origin", "assignments", "fence")
 
-    def __init__(self, version: int, origin: str, assignments: Dict[int, str]):
+    def __init__(
+        self,
+        version: int,
+        origin: str,
+        assignments: Dict[int, str],
+        fence: int = 0,
+    ):
         self.version = version
         self.origin = origin
         self.assignments = assignments
+        self.fence = fence
 
     def owner(self, shard: int) -> Optional[str]:
         return self.assignments.get(shard)
 
     def supersedes(self, other: "ShardTable") -> bool:
+        if self.fence != other.fence:
+            return self.fence > other.fence
         if self.version != other.version:
             return self.version > other.version
         if self.assignments == other.assignments:
@@ -144,7 +163,10 @@ class ShardTable:
         return self.origin < other.origin  # deterministic tiebreak
 
     def __repr__(self) -> str:  # pragma: no cover
-        return f"ShardTable(v{self.version}@{self.origin}, {len(self.assignments)} shards)"
+        return (
+            f"ShardTable(v{self.version}@{self.origin}"
+            f"/f{self.fence}, {len(self.assignments)} shards)"
+        )
 
 
 # ------------------------------------------------------------------- #
@@ -236,6 +258,49 @@ class _JournalSnapCmd(_EntityCtl):
             self.region.type_name, shard, self.key, self.epoch, blob
         )
         return None
+
+
+class _QuarantineCmd(_EntityCtl):
+    """Split-brain quarantine capture (cluster/membership.py): this
+    node LOST the verdict, so the entity drains to the journal and
+    stops serving instead of double-serving against the winner's
+    incarnation.  Runs on the entity's own thread, like the handoff
+    capture: snapshot, drain the mailbox (with engine dead-letter
+    accounting), journal everything, stop."""
+
+    __slots__ = ("region",)
+
+    def __init__(self, region: "ShardRegion"):
+        self.region = region
+
+    def apply(self, entity: "Entity") -> Any:
+        from ..runtime.behaviors import Behaviors
+        from .migration import _drain_for_capture
+
+        ctx = entity.context
+        try:
+            snapshot = entity.snapshot_state()
+        except Exception:  # a failing snapshot must not wedge the drain
+            import traceback
+
+            traceback.print_exc()
+            # Sentinel, NOT None: None is a legitimate "restart fresh"
+            # state, but a FAILED capture must not open a blank epoch
+            # that supersedes the key's last valid journaled snapshot —
+            # the drain keeps the existing epoch and journals only the
+            # mailbox tail.
+            snapshot = _SNAPSHOT_FAILED
+        pending = _drain_for_capture(ctx)
+        tap = ctx.engine.tap
+        if tap is not None:
+            try:
+                tap.on_migrate_out(ctx.cell, entity.key)
+            except Exception:  # taps observe, never alter control flow
+                import traceback
+
+                traceback.print_exc()
+        self.region._quarantine_captured(entity.key, snapshot, pending)
+        return Behaviors.stopped()
 
 
 class EntityRef:
@@ -690,6 +755,42 @@ class ShardRegion:
             for payload in buffered:
                 self._redeliver(cell, key, payload, journal)
 
+    def _quarantine_captured(
+        self, key: str, snapshot: Any, pending: List[Any]
+    ) -> None:
+        """Entity-thread completion of a quarantine capture: checkpoint
+        the final state + the drained-but-unprocessed tail to the
+        journal (still under THIS side's fence — at heal the recovery
+        merge applies the conflict rule), then drop the record.  The
+        mailbox tail was already journaled at original delivery, so
+        replay covers it; region buffers were NOT (the buffering path
+        skips the journal), so they park in the cluster's deferred
+        queue for a post-heal re-route."""
+        journal = self.cluster.journal
+        if journal is not None:
+            try:
+                if snapshot is not _SNAPSHOT_FAILED:
+                    self._journal_open(key, snapshot)
+                # A failed capture keeps the key's existing epoch: the
+                # prior base snapshot stays authoritative and the tail
+                # below appends under it — a blank epoch here would
+                # supersede valid state with nothing.
+                for payload in pending:
+                    self._journal_command(key, payload)
+            except Exception:  # durability must not abort the drain
+                import traceback
+
+                traceback.print_exc()
+        with self._lock:
+            rec = self._entities.get(key)
+            if rec is not None and rec.status == _QUARANTINING:
+                self._entities.pop(key)
+            buffered = self._buffers.pop(key, [])
+        for payload in buffered:
+            self.cluster._defer(self.type_name, key, payload)
+        if journal is not None:
+            journal.forget(self.type_name, key)
+
     def _redeliver(self, cell: "ActorCell", key: str, payload: Any, journal) -> None:
         """One reactivation/replay delivery.  Three invariants: (a)
         these payloads were already admitted (acked, shipped, or
@@ -864,6 +965,34 @@ class ClusterSharding:
                 ),
                 fault_fn=_journal_fault if fabric_ref is not None else None,
             )
+        #: split-brain arbiter (cluster/membership.py).  "off" disables
+        #: arbitration entirely — every verdict acts immediately, the
+        #: pre-PR-13 behavior.
+        strategy = config.get_string("uigc.cluster.sbr-strategy") or "off"
+        self.arbiter = None
+        if strategy != "off":
+            from .membership import MembershipArbiter
+
+            self.arbiter = MembershipArbiter(
+                system.address,
+                strategy=strategy,
+                settle_s=config.get_int("uigc.cluster.sbr-settle") / 1000.0,
+                quorum_size=config.get_int("uigc.cluster.sbr-quorum-size"),
+                min_members=config.get_int("uigc.cluster.sbr-min-members"),
+            )
+        #: this node LOST a split-brain verdict: placement stopped,
+        #: entities drained to the journal, routing parks everything
+        #: until a survivor's fence arrives through the handshake
+        self._quarantined = False
+        #: the quarantine drain finished and the journal froze
+        self._quarantine_checkpointed = False
+        #: entities drained by the quarantine (for the settle event)
+        self._quarantine_entities = 0
+        #: previously-downed addresses whose links are back up but whose
+        #: ``mship`` handshake has not yet confirmed the adopted fence —
+        #: they are NOT placement members until it does
+        self._pending_rejoin: set = set()
+
         #: key -> shard memo: the blake2b in shard_of was a measurable
         #: slice of every routed message.  GIL-atomic dict ops, bounded
         #: by wholesale clear (hot keys re-warm in one burst).
@@ -1002,6 +1131,17 @@ class ClusterSharding:
     def home_of(self, key: str) -> Optional[str]:
         return self._table.owner(self.shard_of_key(key))
 
+    @property
+    def current_fence(self) -> int:
+        """The partition era this node operates under (0 when
+        arbitration is off — the pre-fencing era every fenced site
+        treats as unordered)."""
+        return self.arbiter.fence if self.arbiter is not None else 0
+
+    @property
+    def quarantined(self) -> bool:
+        return self._quarantined
+
     def members(self) -> List[str]:
         with self._lock:
             return sorted(self._members)
@@ -1026,6 +1166,20 @@ class ClusterSharding:
         ``EntityRef.tell``; transport frames, deferred re-routes and
         migration straggler forwards degrade to shed-oldest instead
         (a raise there would kill a receive loop or the coordinator)."""
+        if self._quarantined:
+            # Losing side of a split-brain verdict: serving here would
+            # be the dual activation the fencing plane exists to
+            # prevent.  Park the message (bounded by deferred_limit);
+            # the post-heal flush re-routes it by the survivor's table.
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.FENCE_REJECTED,
+                    site="route",
+                    key=key,
+                    type=type_name,
+                )
+            self._defer(type_name, key, payload)
+            return
         shard = self.shard_of_key(key)
         home = self._table.owner(shard)
         if home is None:
@@ -1082,7 +1236,10 @@ class ClusterSharding:
             payload, peer_ids(home) if peer_ids is not None else ()
         )
         if not self._send_frame(
-            home, wire.encode_entity_frame(type_name, key, hops + 1, encoded)
+            home,
+            wire.encode_entity_frame(
+                type_name, key, hops + 1, encoded, self.current_fence
+            ),
         ):
             self._defer(type_name, key, payload)
 
@@ -1157,6 +1314,20 @@ class ClusterSharding:
         )
 
     def _member_up(self, address: str) -> None:
+        if self.arbiter is not None and address != self.address:
+            admitted = self.arbiter.on_member_up(address)
+            # Exchange the membership handshake on every link-up: it
+            # carries the fence, the live view and the join stamps —
+            # fence sync for fresh joiners, the rejoin protocol for
+            # healed ones, seniority convergence for keep-oldest.
+            self._send_mship(address)
+            if not admitted:
+                # Downed this era (or we are quarantined): placement
+                # admission waits for the peer's handshake to confirm
+                # the adopted fence.
+                with self._lock:
+                    self._pending_rejoin.add(address)
+                return
         with self._lock:
             self._leaving.discard(address)
             if address in self._members:
@@ -1170,6 +1341,8 @@ class ClusterSharding:
         grants armed — it is alive and migrating its entities to us."""
         if address == self.address:
             return
+        if self.arbiter is not None:
+            self.arbiter.on_leaving(address)
         with self._lock:
             already = address in self._leaving
             self._leaving.add(address)
@@ -1185,6 +1358,21 @@ class ClusterSharding:
             self._flush_deferred()
 
     def _member_removed(self, address: str) -> None:
+        with self._lock:
+            self._pending_rejoin.discard(address)
+        if self.arbiter is not None and self.arbiter.track_unreachable(address):
+            # Arbitrated: the verdict (and with it shard inheritance)
+            # waits for the settle window — the side that will LOSE
+            # must never start acquiring shards.  The tick polls the
+            # decision (``_poll_arbiter``).
+            return
+        self._apply_member_removed(address)
+
+    def _apply_member_removed(self, address: str) -> None:
+        """Execute one removal: release grant state, recompute, absorb
+        the dead node's journaled entities.  Runs immediately when
+        arbitration is off (or not applicable), or at decision time on
+        the SURVIVING side of a settled verdict."""
         with self._lock:
             self._leaving.discard(address)
             was_member = address in self._members
@@ -1246,6 +1434,231 @@ class ClusterSharding:
                         import traceback
 
                         traceback.print_exc()
+
+    # -- split-brain arbitration (cluster/membership.py) -------------- #
+
+    def _poll_arbiter(self) -> None:
+        """Tick-driven: execute a settled split-brain verdict.  The
+        surviving side bumps its fence and absorbs the downed members'
+        shards; the losing side quarantines."""
+        decision = self.arbiter.poll()
+        if decision is None:
+            return
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SBR_DECISION,
+                strategy=decision.strategy,
+                survived=decision.survived,
+                downed=list(decision.downed),
+                live=len(decision.live),
+                seen=len(decision.seen),
+                fence=decision.fence,
+                reason=decision.reason,
+            )
+        if decision.survived:
+            if self.journal is not None:
+                self.journal.set_fence(decision.fence)
+            for address in decision.downed:
+                self._apply_member_removed(address)
+            # Stamp the new fence even when assignments happen not to
+            # change, and push it to the same-side peers immediately.
+            self._recompute_table(force=True)
+            self._broadcast_mship()
+        else:
+            self._enter_quarantine(decision)
+
+    def _enter_quarantine(self, decision) -> None:
+        """This node LOST the verdict: stop acquiring shards, drain
+        every hosted entity to the journal, stop serving.  Nothing is
+        deleted — the journal keeps the final state (under the stale
+        fence, subject to the heal-time conflict rule) and parked
+        traffic re-routes after the rejoin."""
+        with self._lock:
+            if self._quarantined:
+                return
+            self._quarantined = True
+            self._quarantine_checkpointed = False
+            self._quarantine_entities = 0
+            self._members = {self.address}
+            # Grant/hold state points across the partition: drop it —
+            # hold buffers park in the deferred queue.
+            for shard in list(self._holds):
+                self._release_hold_locked(shard)
+            self._grant_watch.clear()
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SBR_DOWNED,
+                strategy=decision.strategy,
+                downed_with=list(decision.downed),
+                reason=decision.reason,
+            )
+        self._quarantine_scan()
+
+    def _quarantine_scan(self) -> int:
+        """Begin (or extend) the drain: every ACTIVE entity gets a
+        quarantine capture (journal checkpoint + stop) through the same
+        transition machinery handoffs use.  Returns captures begun.
+        Called on entry AND every tick until the freeze: a delivery
+        that raced the lock-free quarantine check in ``route`` can
+        activate an entity AFTER the first sweep — the re-scan catches
+        such strays before the journal freezes, so nothing can keep
+        serving from memory against a frozen append plane."""
+        with self._lock:
+            regions = list(self._regions.values())
+        begun = 0
+        for region in regions:
+            for key in region.active_keys():
+                if region._begin_transition(
+                    key, _QUARANTINING, _QuarantineCmd(region)
+                ):
+                    begun += 1
+        self._quarantine_entities += begun
+        return begun
+
+    def _quarantine_drained(self) -> bool:
+        """Nothing left that the freeze could strand.  ACTIVE counts as
+        not-drained (an activation that raced the lock-free route gate
+        lands AFTER a sweep — the next tick's re-scan captures it, and
+        freezing under it would leave an entity serving from memory
+        against a frozen journal for the whole partition), as does a
+        capture in flight and a local passivation spill.  A pre-verdict
+        HANDOFF record deliberately does NOT block the freeze: its
+        state was journal-checkpointed at capture, and its ack depends
+        on a peer across the cut — waiting would wedge the quarantine
+        forever."""
+        with self._lock:
+            regions = list(self._regions.values())
+        for region in regions:
+            with region._lock:
+                if any(
+                    rec.status in (_ACTIVE, _QUARANTINING, _PASSIVATING)
+                    for rec in region._entities.values()
+                ):
+                    return False
+        return True
+
+    def _quarantine_settle(self) -> None:
+        """Every capture landed: checkpoint (flush + fsync) and FREEZE
+        the journal — from here on a stale append is refused at the
+        append site, so zero fenced-stale records can reach a recovery
+        merge."""
+        with self._lock:
+            if self._quarantine_checkpointed or not self._quarantined:
+                return
+            self._quarantine_checkpointed = True
+        if self.journal is not None:
+            self.journal.checkpoint()
+            self.journal.freeze()
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SBR_QUARANTINE,
+                entities=self._quarantine_entities,
+                checkpointed=self.journal is not None,
+            )
+
+    def _leave_quarantine(self, fence: int, via: str) -> None:
+        """Heal-time rejoin: a survivor's handshake delivered a higher
+        fence.  Adopt it, unfreeze the journal, and re-enter the
+        cluster as a fresh member — peers re-admit us through their own
+        handshakes, the rebalance hands our share of the keyspace back,
+        and journal recovery (conflict rule applied) reconstructs it."""
+        self.arbiter.rejoin(fence)
+        if self.journal is not None:
+            self.journal.unfreeze(fence)
+            self.journal.invalidate_cache()
+        with self._lock:
+            self._quarantined = False
+            self._quarantine_checkpointed = False
+            self._members = {self.address}
+        if events.recorder.enabled:
+            events.recorder.commit(events.SBR_REJOIN, fence=fence, via=via)
+        self._admit_rejoin(via)
+
+    def _admit_rejoin(self, address: str) -> None:
+        """A previously-downed peer completed the handshake (its view
+        carries our fence): re-admit it to placement."""
+        self.arbiter.admit(address)
+        with self._lock:
+            self._pending_rejoin.discard(address)
+            self._leaving.discard(address)
+            already = address in self._members
+            self._members.add(address)
+        if not already:
+            self._recompute_table()
+            self._flush_deferred()
+
+    def _send_mship(self, address: str) -> None:
+        if self.arbiter is None or address == self.address:
+            return
+        fence, members, stamps, quarantined = self.arbiter.view()
+        self._send_frame(
+            address,
+            wire.encode_mship(
+                self.address,
+                fence,
+                members,
+                stamps,
+                quarantined,
+                self._table.version,
+            ),
+        )
+
+    def _broadcast_mship(self) -> None:
+        if self.arbiter is None:
+            return
+        with self._lock:
+            targets = set(self._members) | set(self._pending_rejoin)
+        targets.discard(self.address)
+        for address in targets:
+            self._send_mship(address)
+
+    def _on_mship(self, from_address: str, frame: tuple) -> None:
+        """Membership handshake / anti-entropy (coordinator thread)."""
+        if self.arbiter is None:
+            return
+        doc = wire.decode_mship(frame)
+        if doc is None:
+            return
+        arbiter = self.arbiter
+        arbiter.merge_stamps(doc["stamps"])
+        peer_fence = doc["fence"]
+        my_fence = arbiter.fence
+        if peer_fence > my_fence:
+            if self._quarantined and not doc["quarantined"]:
+                if not self._quarantine_checkpointed:
+                    # The drain is still landing on entity threads: a
+                    # rejoin NOW would unfreeze the journal and let the
+                    # remaining captures stamp this side's divergent
+                    # state with the SURVIVOR's fence — unrejectable at
+                    # the next merge.  Wait; the peer's periodic mship
+                    # gossip retries the handshake.  (Same thread as
+                    # the tick that sets the flag — no race.)
+                    return
+                self._leave_quarantine(peer_fence, via=from_address)
+            else:
+                arbiter.adopt_fence(peer_fence)
+                if self.journal is not None:
+                    self.journal.set_fence(peer_fence)
+                # Re-stamp the local table under the adopted fence so
+                # our gossip is comparable again.
+                self._recompute_table(force=True)
+            self._send_mship(from_address)  # confirm the adoption
+            return
+        if peer_fence < my_fence:
+            self._send_mship(from_address)  # help the peer catch up
+            return
+        # Equal fences: disagreement detection + rejoin admission.
+        conflicts = arbiter.disagreement(doc)
+        if conflicts and events.recorder.enabled:
+            events.recorder.commit(
+                events.MEMBERSHIP_DISAGREEMENT,
+                peer=from_address,
+                conflicts=conflicts[:8],
+            )
+        with self._lock:
+            pending = from_address in self._pending_rejoin
+        if pending and not doc["quarantined"] and not self._quarantined:
+            self._admit_rejoin(from_address)
 
     def rebalance(self) -> None:
         """Explicit rebalance kick: recompute from the current member
@@ -1328,8 +1741,17 @@ class ClusterSharding:
             if assignments == self._table.assignments and not force:
                 return
             old = self._table.assignments
+            # Fence = max(arbiter, adopted table): a peer whose shard
+            # gossip outran its mship handshake has already adopted a
+            # higher-fence table — recomputing at the (stale) arbiter
+            # fence would regress it, misroute toward downed members,
+            # and gossip a table everyone rejects.  Fences only move
+            # forward.
             self._table = ShardTable(
-                self._table.version + 1, self.address, assignments
+                self._table.version + 1,
+                self.address,
+                assignments,
+                fence=max(self.current_fence, self._table.fence),
             )
             table = self._table
             self._table_transition(old, assignments)
@@ -1343,8 +1765,14 @@ class ClusterSharding:
         self._gossip()
         self._scan_handoffs()
 
-    def _adopt_table(self, version: int, origin: str, assignments: Dict[int, str]) -> None:
-        incoming = ShardTable(version, origin, assignments)
+    def _adopt_table(
+        self,
+        version: int,
+        origin: str,
+        assignments: Dict[int, str],
+        fence: int = 0,
+    ) -> None:
+        incoming = ShardTable(version, origin, assignments, fence=fence)
         with self._lock:
             if not incoming.supersedes(self._table):
                 return
@@ -1455,13 +1883,16 @@ class ClusterSharding:
                     del self._grant_watch[shard]
         if grant_to is not None:
             self._send_frame(
-                grant_to, wire.encode_shard_grant(shard, self.address)
+                grant_to,
+                wire.encode_shard_grant(shard, self.address, self.current_fence),
             )
 
     def _gossip(self) -> None:
         table = self._table
         self._gossiped_version = table.version
-        frame = wire.encode_shard_frame(table.version, table.origin, table.assignments)
+        frame = wire.encode_shard_frame(
+            table.version, table.origin, table.assignments, table.fence
+        )
         for member in self.members():
             if member != self.address:
                 self._send_frame(member, frame)
@@ -1552,12 +1983,31 @@ class ClusterSharding:
                     del self._grant_watch[shard]
                     ready.append((shard, watch.owner))
         for shard, owner in ready:
-            self._send_frame(owner, wire.encode_shard_grant(shard, self.address))
+            self._send_frame(
+                owner,
+                wire.encode_shard_grant(shard, self.address, self.current_fence),
+            )
 
     def _tick(self) -> None:
         if self._closed:
             return
         self._ticks += 1
+        if self.arbiter is not None:
+            self._poll_arbiter()
+            if self._quarantined:
+                if not self._quarantine_checkpointed:
+                    # Re-sweep for stray activations that raced the
+                    # quarantine flag, then freeze once truly drained.
+                    if (
+                        self._quarantine_scan() == 0
+                        and self._quarantine_drained()
+                    ):
+                        self._quarantine_settle()
+            elif self._ticks % 5 == 0:
+                # Periodic membership anti-entropy: fence sync for
+                # laggards, disagreement detection for the
+                # split_brain_suspected alert.
+                self._broadcast_mship()
         # Anti-entropy gossip heals dropped gossip frames, but a quiet
         # cluster does not need the full table rebroadcast 10x/second:
         # gossip immediately when the version moved, else every 5th tick.
@@ -1567,6 +2017,11 @@ class ClusterSharding:
             # Re-broadcast the departure until death: a peer that
             # missed the one-shot "sleave" keeps assigning shards back.
             self._broadcast_leave()
+        if self._quarantined:
+            # Not serving: no handoffs, no passivation, no deferred
+            # flush (route would only re-park everything) — just wait
+            # for the drain to settle and the heal handshake to arrive.
+            return
         self.migrations.retry_due()
         now = time.monotonic()
         with self._lock:
@@ -1614,13 +2069,28 @@ class ClusterSharding:
         decoded = wire.decode_entity_frame(frame)
         if decoded is None:
             return
-        type_name, key, hops, payload_bytes = decoded
+        type_name, key, hops, payload_bytes, fence = decoded
         try:
             payload = wire.decode_message(self._codec, payload_bytes)
         except Exception:
             import traceback
 
             traceback.print_exc()
+            return
+        if fence > self.current_fence:
+            # Routed under a NEWER partition era than ours: WE are the
+            # stale side.  Park the message (it re-routes after the
+            # handshake catches us up) and ask the sender for its view.
+            if events.recorder.enabled:
+                events.recorder.commit(
+                    events.FENCE_REJECTED,
+                    site="ent",
+                    key=key,
+                    type=type_name,
+                    fence=fence,
+                )
+            self._defer(type_name, key, payload)
+            self._send_mship(from_address)
             return
         if self.home_of(key) != self.address and events.recorder.enabled:
             events.recorder.commit(
@@ -1644,7 +2114,20 @@ class ClusterSharding:
             decoded = wire.decode_shard_grant(frame)
             if decoded is None:
                 return
-            shard, origin = decoded
+            shard, origin, fence = decoded
+            if fence < self.current_fence:
+                # A grant minted under a superseded era (a stale owner
+                # releasing ownership it no longer holds): refuse it —
+                # the hold's timeout is the legitimate escape.
+                if events.recorder.enabled:
+                    events.recorder.commit(
+                        events.FENCE_REJECTED,
+                        site="sgrant",
+                        shard=shard,
+                        origin=origin,
+                        fence=fence,
+                    )
+                return
             with self._lock:
                 holder = self._holds.get(shard, _NOT_HELD)
                 granted = holder is not _NOT_HELD and (
@@ -1656,6 +2139,8 @@ class ClusterSharding:
             origin = wire.decode_shard_leave(frame)
             if origin is not None:
                 self._member_leaving(origin)
+        elif kind == "mship":
+            self._on_mship(from_address, frame)
 
     # -- observability ----------------------------------------------- #
 
@@ -1709,16 +2194,20 @@ class ClusterSharding:
         out = {
             "table_version": table.version,
             "table_size": len(table.assignments),
+            "table_fence": table.fence,
             "held_shards": held,
             "members": self.members(),
             "draining": draining,
             "leaving": leaving,
+            "quarantined": self._quarantined,
             "active": sum(r.active_count() for r in regions),
             "passivated": sum(r.passive_count() for r in regions),
             "buffered": sum(r.buffered_depth() for r in regions),
             "migrations_pending": self.migrations.pending_count(),
             "regions": [r.stats() for r in regions],
         }
+        if self.arbiter is not None:
+            out["membership"] = self.arbiter.stats()
         if self.journal is not None:
             out["journal"] = self.journal.stats()
         return out

@@ -113,6 +113,14 @@ DEFAULTS: Dict[str, Any] = {
     # Milliseconds of backoff before the first reconnect attempt,
     # doubled per attempt.
     "uigc.node.reconnect-backoff": 50,
+    # Re-admit a SAME-incarnation peer that reconnects after its
+    # MemberRemoved verdict (a healed partition).  The rejoin retires
+    # the old transport state wholesale — fresh stream, fresh links,
+    # MemberUp to subscribers — and the cluster/collector layers run
+    # their own reconciliation (split-brain resolver, undo-log reset).
+    # False restores the legacy refusal: a removed member can only come
+    # back as a fresh incarnation (process restart).
+    "uigc.node.heal-rejoin": True,
     # Multi-frame batch units on peer links: every frame queued for one
     # peer is coalesced by its writer thread into a single "fb" wire
     # unit flushed in one sendall.  The capability is negotiated in the
@@ -219,6 +227,30 @@ DEFAULTS: Dict[str, Any] = {
     # Mailbox bound applied to entity cells specifically; 0 inherits
     # uigc.runtime.mailbox-limit.
     "uigc.cluster.entity-mailbox-limit": 0,
+    # --- Partition tolerance (uigc_tpu/cluster/membership.py) ---
+    # Split-brain resolution strategy applied when heartbeat verdicts
+    # split the membership: "keep-majority" (the larger half survives;
+    # 50/50 keeps the half with the lowest address), "static-quorum"
+    # (survive iff >= sbr-quorum-size members stay live), "keep-oldest"
+    # (the half holding the most senior member survives), "down-all"
+    # (any partition downs every side; operators restart), or "off"
+    # (no arbitration — every verdict acts immediately, the pre-fencing
+    # behavior).  The LOSING side quarantines: it drains its entities
+    # to the journal, freezes the append plane, and stops serving until
+    # a heal-time handshake hands it the survivor's fence.
+    "uigc.cluster.sbr-strategy": "keep-majority",
+    # Milliseconds an unreachability verdict waits for the full
+    # unreachable set to form before a strategy judges it (one crash
+    # and a half-cluster partition look identical to the FIRST
+    # verdict).  Shard inheritance is deferred for the window.
+    "uigc.cluster.sbr-settle": 200,
+    # static-quorum only: members that must stay live to survive; 0
+    # derives the majority quorum from the era's membership.
+    "uigc.cluster.sbr-quorum-size": 0,
+    # Cluster size below which arbitration is skipped (majority is
+    # undefined for 1-2 nodes): removals act immediately, the legacy
+    # availability behavior.
+    "uigc.cluster.sbr-min-members": 3,
     # --- Correctness tooling (uigc_tpu/analysis; no reference analogue,
     # the reference debugged with in-source asserts) ---
     # Attach the uigcsan online sanitizer at system creation: a shadow

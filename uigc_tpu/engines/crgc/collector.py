@@ -230,14 +230,21 @@ class Bookkeeper(RawBehavior):
             return
         self.remote_gcs[address] = peer_system.engine.bookkeeper_cell
         if address in self.downed_gcs:
-            # Rolling-restart rejoin: a FRESH incarnation of a downed
-            # address (the fabric only re-admits new nonces).  Its GC
-            # stream starts from zero, so the old incarnation's undo
-            # log must not absorb the newcomer's deltas — reset it.
-            # If the old log was still awaiting its fold quorum, the
-            # skipped fold can only LEAK the dead incarnation's refs
-            # (marks stay), never collect a live actor: safe direction.
+            # Rejoin of a downed address: a FRESH incarnation after a
+            # rolling restart, or the SAME incarnation healing after a
+            # partition verdict (``uigc.node.heal-rejoin``).  Either
+            # way its re-admitted stream must not fold into the dead
+            # era's undo state: reset the log, and clear the one-shot
+            # undone latch so a LATER death of the rejoined peer folds
+            # again.  If the old log was still awaiting its fold
+            # quorum, the skipped fold can only LEAK the dead era's
+            # refs (marks stay), never collect a live actor: safe
+            # direction — the same argument covers the healed peer's
+            # pre-partition contributions, which the death-time fold
+            # already reverted (re-sent refs re-register as they
+            # arrive).
             self.downed_gcs.discard(address)
+            self.undone_gcs.discard(address)
             self.undo_logs[address] = UndoLog(address)
         elif address not in self.undo_logs:
             self.undo_logs[address] = UndoLog(address)

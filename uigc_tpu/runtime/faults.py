@@ -28,7 +28,14 @@ receiver-observable outcome):
                 decode it and drops it as corrupt.
 - partitions    every frame between a partitioned pair drops (both
                 directions) until ``heal`` — heartbeats included, which
-                is how failure-detector tests starve a node.
+                is how failure-detector tests starve a node.  With
+                ``oneway=True`` only the a->b direction drops (a
+                half-open link: a's sends vanish so b never hears a,
+                while a still hears b — the asymmetric-failure case
+                phi detectors disagree on), and
+                ``heal_after(seconds)`` schedules the cut to mend by
+                itself, so a chaos script can express flapping links as
+                data instead of timer threads.
 - ``crash_at``  after this node transmits its N-th protocol frame
                 (heartbeats excluded — they are timer-driven and would
                 make the crash point wall-clock-dependent), the fabric
@@ -54,6 +61,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 import zlib
 from collections import Counter
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
@@ -115,6 +123,13 @@ class FaultPlan:
         self._rules: List[_Rule] = []
         self._inbound: List[_Rule] = []
         self._partitions: set = set()  # frozenset({a, b})
+        #: directed cuts: (src, dst) pairs ("*" wildcards allowed) that
+        #: drop ONLY src->dst traffic — the half-open-link model
+        self._oneway: set = set()
+        #: scheduled heals: (monotonic deadline, a, b, oneway) —
+        #: consulted lazily on every partition check, so no timer
+        #: thread perturbs determinism
+        self._heals: List[tuple] = []
         self._crash_at: Dict[str, int] = {}
         #: address -> [appends_remaining, keep_bytes, keep_fraction]
         #: for the torn-journal-append injection (crash-at-byte)
@@ -167,20 +182,70 @@ class FaultPlan:
             self._inbound.append(_Rule(DROP, src, dst, "*", prob, count, match))
         return self
 
-    def partition(self, a: str, b: str) -> "FaultPlan":
+    def partition(self, a: str, b: str, oneway: bool = False) -> "FaultPlan":
+        """Cut the link between ``a`` and ``b``.  Symmetric by default;
+        ``oneway=True`` drops only a->b frames (b's detector starves,
+        a's stays fed — the asymmetric verdict chaos tests script)."""
         with self._lock:
-            self._partitions.add(frozenset((a, b)))
+            if oneway:
+                self._oneway.add((a, b))
+            else:
+                self._partitions.add(frozenset((a, b)))
         return self
 
     def heal(self, a: str, b: str) -> "FaultPlan":
+        """Mend every cut between ``a`` and ``b`` (both directions,
+        symmetric and one-way alike).  A ``"*"`` on EITHER side sweeps
+        every cut naming the other endpoint — specific pairs and
+        wildcard isolations alike — and ``heal("*", "*")`` mends
+        everything; argument order never changes the outcome."""
         with self._lock:
-            self._partitions.discard(frozenset((a, b)))
+            self._heal_locked(a, b)
         return self
 
-    def isolate(self, address: str) -> "FaultPlan":
-        """Partition ``address`` from everyone (wildcard partition)."""
+    def _heal_locked(self, a: str, b: str) -> None:
+        if a == "*" and b == "*":
+            self._partitions.clear()
+            self._oneway.clear()
+            return
+        if a == "*" or b == "*":
+            named = b if a == "*" else a
+            self._partitions = {
+                p for p in self._partitions if named not in p
+            }
+            self._oneway = {
+                p for p in self._oneway if named not in p
+            }
+            return
+        # Specific pair: mend exactly these two endpoints' mutual cuts
+        # (a wildcard isolation of either endpoint covers MORE than the
+        # pair and deliberately stays).
+        self._partitions.discard(frozenset((a, b)))
+        self._oneway.discard((a, b))
+        self._oneway.discard((b, a))
+
+    def heal_after(
+        self, seconds: float, a: str = "*", b: str = "*"
+    ) -> "FaultPlan":
+        """Schedule a heal: after ``seconds`` the cut(s) between ``a``
+        and ``b`` (default: every partition) mend on their own — the
+        primitive flapping-link scripts are built from
+        (``partition(); heal_after(0.5); ...``), with no timer thread
+        involved: due heals apply lazily on the next partition check."""
         with self._lock:
-            self._partitions.add(frozenset((address, "*")))
+            self._heals.append((time.monotonic() + seconds, a, b))
+        return self
+
+    def isolate(self, address: str, oneway: bool = False) -> "FaultPlan":
+        """Partition ``address`` from everyone (wildcard partition).
+        ``oneway=True`` drops only the frames ``address`` SENDS — it
+        goes silent to every peer (their detectors starve) while it
+        still hears all of them."""
+        with self._lock:
+            if oneway:
+                self._oneway.add((address, "*"))
+            else:
+                self._partitions.add(frozenset((address, "*")))
         return self
 
     def crash_at(self, address: str, after_frames: int) -> "FaultPlan":
@@ -225,11 +290,29 @@ class FaultPlan:
         return rng
 
     def _partitioned(self, src: str, dst: str) -> bool:
-        return (
+        # Caller holds self._lock.  Apply due scheduled heals first so
+        # a healed link delivers from the very next frame.
+        if self._heals:
+            now = time.monotonic()
+            due = [h for h in self._heals if h[0] <= now]
+            if due:
+                self._heals = [h for h in self._heals if h[0] > now]
+                for _deadline, a, b in due:
+                    self._heal_locked(a, b)
+        if (
             frozenset((src, dst)) in self._partitions
             or frozenset((src, "*")) in self._partitions
             or frozenset((dst, "*")) in self._partitions
-        )
+        ):
+            return True
+        if self._oneway:
+            ow = self._oneway
+            return (
+                (src, dst) in ow
+                or (src, "*") in ow
+                or ("*", dst) in ow
+            )
+        return False
 
     def outbound(self, src: str, dst: str, kind: str) -> Tuple[str, int]:
         """Verdict for one outbound frame on link src->dst.  Returns

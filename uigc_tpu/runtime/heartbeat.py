@@ -83,6 +83,14 @@ class PhiAccrualFailureDetector:
         # Tail probability of the normal distribution via the logistic
         # approximation Akka's PhiAccrualFailureDetector uses.
         y = (elapsed - mean) / std
+        if y < -20.0:
+            # Far before the expected arrival (a generous acceptable
+            # pause against a tight cadence): suspicion is zero, and
+            # the cubic exponent below would overflow exp() for large
+            # negative y — which used to abort the whole monitor tick
+            # mid-loop and silently blind the detector for every peer
+            # AFTER the freshly-heard one.
+            return 0.0
         e = math.exp(-y * (1.5976 + 0.070566 * y * y))
         if elapsed > mean:
             p = e / (1.0 + e)
@@ -154,6 +162,17 @@ class HeartbeatMonitor:
         with self._lock:
             self._detectors.pop(address, None)
             self._suspected.discard(address)
+
+    def revive(self, address: str) -> None:
+        """A downed peer was re-admitted (a heal rejoin or a fresh
+        incarnation on the same address): start watching it again with
+        a FRESH detector.  Without this the one-shot ``_downed`` latch
+        would leave the rejoined peer unmonitored forever — its second
+        death could only ever be detected by EOF."""
+        with self._lock:
+            self._downed.discard(address)
+            self._suspected.discard(address)
+            self._detectors.pop(address, None)
 
     def phi(self, address: str) -> float:
         return self.detector_for(address).phi()

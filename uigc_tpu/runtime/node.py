@@ -425,6 +425,13 @@ class NodeFabric:
         self._shm_ring_bytes = 1 << 20
         #: inbound decode placement: "off" | "on" | "auto"
         self._decode_mode = "auto"
+        #: re-admit a SAME-incarnation peer that reconnects after its
+        #: MemberRemoved verdict (a healed partition).  The rejoin gets
+        #: a completely fresh stream — old transport state retires
+        #: wholesale, exactly like the rolling-restart rejoin — and the
+        #: cluster/collector layers run their own reconciliation
+        #: (uigc_tpu/cluster/membership.py).  Off = the legacy refusal.
+        self._heal_rejoin = True
         #: this process-incarnation's identity, exchanged in the hello:
         #: a reconnect that reaches a RESTARTED peer (same address, new
         #: process) must not resume the old frame stream — its sequence
@@ -458,6 +465,7 @@ class NodeFabric:
         self._shm_enabled = config.get_bool("uigc.node.shm-transport")
         self._shm_ring_bytes = config.get_int("uigc.node.shm-ring-bytes")
         self._decode_mode = config.get_string("uigc.node.decode-workers")
+        self._heal_rejoin = config.get_bool("uigc.node.heal-rejoin")
         hb_ms = config.get_int("uigc.node.heartbeat-interval")
         if hb_ms > 0:
             from .heartbeat import HeartbeatMonitor
@@ -671,11 +679,21 @@ class NodeFabric:
         if stale:
             self._declare_dead(address, "restart")
         retired = None
+        healed = False
         with self._lock:
             if address in self.crashed:
                 old = self._peers.get(address)
                 if old is not None and old.nonce == nonce:
-                    return False  # the SAME dead incarnation: refuse
+                    if not self._heal_rejoin:
+                        return False  # the SAME dead incarnation: refuse
+                    # Heal rejoin: the SAME incarnation reconnecting
+                    # after a partition verdict.  Its old frame stream
+                    # is unsound to resume (both sides finalized the
+                    # dead link and reverted its effects), so it gets
+                    # the restart treatment — fresh stream, fresh
+                    # links — and the layers above reconcile through
+                    # the membership handshake.
+                    healed = True
                 # Rolling-restart rejoin: retire the dead incarnation's
                 # transport state wholesale — stream numbering, links,
                 # cached proxies — so the newcomer starts from zero on
@@ -738,7 +756,14 @@ class NodeFabric:
             self._declare_dead(address, "restart")
             return False
         if self._hb is not None:
+            if healed or retired is not None:
+                # Rejoin of a previously-downed address (heal or fresh
+                # incarnation): clear the one-shot down latch so the
+                # monitor watches the peer again.
+                self._hb.revive(address)
             self._hb.record(address)
+        if healed:
+            events.recorder.commit(events.LINK_HEALED, address=address)
         if known:
             events.recorder.commit(
                 events.LINK_RECONNECT, address=address, side="accept"

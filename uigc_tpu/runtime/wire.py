@@ -463,73 +463,154 @@ def apply_trace_header(msg: Any, header: Any) -> None:
 # ------------------------------------------------------------------- #
 
 #: Frame kinds owned by the cluster layer.
-SHARD_FRAME_KINDS = ("shard", "ent", "mig", "miga", "sgrant", "sleave")
+SHARD_FRAME_KINDS = ("shard", "ent", "mig", "miga", "sgrant", "sleave", "mship")
 
 
-def encode_shard_frame(version: int, origin: str, assignments: dict) -> tuple:
-    """Shard-table gossip: ``(kind, version, origin, {shard: address})``."""
-    return ("shard", int(version), origin, dict(assignments))
+def _frame_fence(frame: tuple, index: int) -> int:
+    """Tolerant read of the trailing fence element the PR 13 epoch-
+    fencing plane appended to the shard/mig/sgrant/ent frames: absent
+    (an older peer) or unreadable decodes as fence 0 — the pre-fencing
+    era, which every fenced site treats as 'no evidence of staleness'."""
+    try:
+        return int(frame[index])
+    except (IndexError, TypeError, ValueError):
+        return 0
+
+
+def encode_shard_frame(
+    version: int, origin: str, assignments: dict, fence: int = 0
+) -> tuple:
+    """Shard-table gossip: ``(kind, version, origin, {shard: address},
+    fence)`` — the fence epoch orders tables across partition eras
+    BEFORE the (version, origin) lamport pair."""
+    return ("shard", int(version), origin, dict(assignments), int(fence))
 
 
 def decode_shard_frame(frame: tuple):
-    """-> (version, origin, assignments) or None."""
+    """-> (version, origin, assignments, fence) or None."""
     try:
         version, origin, assignments = frame[1], frame[2], frame[3]
         if not isinstance(version, int) or not isinstance(assignments, dict):
             return None
-        return version, str(origin), {int(s): str(a) for s, a in assignments.items()}
+        return (
+            version,
+            str(origin),
+            {int(s): str(a) for s, a in assignments.items()},
+            _frame_fence(frame, 4),
+        )
     except (IndexError, TypeError, ValueError):
         return None
 
 
-def encode_entity_frame(type_name: str, key: str, hops: int, payload: bytes) -> tuple:
+def encode_entity_frame(
+    type_name: str, key: str, hops: int, payload: bytes, fence: int = 0
+) -> tuple:
     """Entity-routed message: the payload bytes come from
-    :func:`encode_message` on the sender."""
-    return ("ent", type_name, key, int(hops), payload)
+    :func:`encode_message` on the sender.  The trailing fence stamps
+    the SENDER's partition era so a receiver can tell a frame routed by
+    a stale membership view from current traffic."""
+    return ("ent", type_name, key, int(hops), payload, int(fence))
 
 
 def decode_entity_frame(frame: tuple):
-    """-> (type_name, key, hops, payload) or None."""
+    """-> (type_name, key, hops, payload, fence) or None."""
     try:
         type_name, key, hops, payload = frame[1], frame[2], frame[3], frame[4]
         if not isinstance(payload, bytes):
             return None
-        return str(type_name), str(key), int(hops), payload
+        return (
+            str(type_name),
+            str(key),
+            int(hops),
+            payload,
+            _frame_fence(frame, 5),
+        )
     except (IndexError, TypeError, ValueError):
         return None
 
 
 def encode_migration_frame(
-    type_name: str, key: str, mig_id: tuple, blob: bytes
+    type_name: str, key: str, mig_id: tuple, blob: bytes, fence: int = 0
 ) -> tuple:
     """Handoff state transfer: ``blob`` is the encode_message bytes of a
-    ``(snapshot, pending_payloads)`` pair."""
-    return ("mig", type_name, key, tuple(mig_id), blob)
+    ``(snapshot, pending_payloads)`` pair.  Fence-stamped at SEND time:
+    a receiver refuses state shipped under a superseded partition era
+    (a stale owner's post-partition copy) instead of merging it."""
+    return ("mig", type_name, key, tuple(mig_id), blob, int(fence))
 
 
 def decode_migration_frame(frame: tuple):
-    """-> (type_name, key, mig_id, blob) or None."""
+    """-> (type_name, key, mig_id, blob, fence) or None."""
     try:
         type_name, key, mig_id, blob = frame[1], frame[2], frame[3], frame[4]
         if not isinstance(blob, bytes) or not isinstance(mig_id, tuple):
             return None
-        return str(type_name), str(key), mig_id, blob
+        return str(type_name), str(key), mig_id, blob, _frame_fence(frame, 5)
     except (IndexError, TypeError, ValueError):
         return None
 
 
-def encode_shard_grant(shard: int, origin: str) -> tuple:
+def encode_shard_grant(shard: int, origin: str, fence: int = 0) -> tuple:
     """Shard-ownership grant: the PREVIOUS owner of ``shard`` tells the
     new owner that every entity it hosted for that shard has been
-    handed off — the new owner may stop holding the shard's traffic."""
-    return ("sgrant", int(shard), origin)
+    handed off — the new owner may stop holding the shard's traffic.
+    Fence-stamped: a grant minted under a superseded era must not
+    release a hold in the current one."""
+    return ("sgrant", int(shard), origin, int(fence))
 
 
 def decode_shard_grant(frame: tuple):
-    """-> (shard, origin) or None."""
+    """-> (shard, origin, fence) or None."""
     try:
         shard, origin = frame[1], frame[2]
-        return int(shard), str(origin)
+        return int(shard), str(origin), _frame_fence(frame, 3)
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_mship(
+    origin: str,
+    fence: int,
+    members: list,
+    stamps: dict,
+    quarantined: bool,
+    table_version: int,
+) -> tuple:
+    """Membership handshake / anti-entropy gossip
+    (uigc_tpu/cluster/membership.py): the sender's partition era
+    (fence), live-member view, join-seniority stamps and quarantine
+    flag.  JSON payload, never pickle — the same data-not-code
+    discipline as the snap/tsq frames: a malformed or malicious peer
+    document can at worst fail ``json.loads``."""
+    doc = {
+        "origin": origin,
+        "fence": int(fence),
+        "members": sorted(members),
+        "stamps": {str(a): int(s) for a, s in stamps.items()},
+        "quarantined": bool(quarantined),
+        "table_version": int(table_version),
+    }
+    return ("mship", origin, json.dumps(doc).encode())
+
+
+def decode_mship(frame: tuple):
+    """-> the handshake document (dict) or None.  Unknown keys are
+    preserved (a newer peer may gossip more); missing keys default."""
+    try:
+        origin, payload = frame[1], frame[2]
+        if not isinstance(payload, bytes):
+            return None
+        doc = json.loads(payload)
+        if not isinstance(doc, dict):
+            return None
+        doc.setdefault("origin", str(origin))
+        doc["fence"] = int(doc.get("fence", 0))
+        doc["members"] = [str(m) for m in doc.get("members", [])]
+        doc["stamps"] = {
+            str(a): int(s) for a, s in dict(doc.get("stamps", {})).items()
+        }
+        doc["quarantined"] = bool(doc.get("quarantined", False))
+        return doc
     except (IndexError, TypeError, ValueError):
         return None
 

@@ -431,6 +431,20 @@ def builtin_rules(config: Any) -> List[AlertRule]:
             "death threshold",
         ),
         AlertRule(
+            "split_brain_suspected",
+            "uigc_membership_disagreements_total",
+            "rate",
+            severity="critical",
+            op=">",
+            value=0.0,
+            window_s=30.0,
+            description="two live peers disagree on membership: a peer "
+            "is serving alongside a member this node downed — a "
+            "partition the split-brain resolver has not (yet) "
+            "arbitrated, or an asymmetric link feeding one-sided "
+            "verdicts (cluster/membership.py)",
+        ),
+        AlertRule(
             "backpressure_spike",
             "uigc_backpressure_total",
             "rate",

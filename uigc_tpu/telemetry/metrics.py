@@ -548,6 +548,27 @@ class EventMetricsBridge:
             "Per-entity journal recovery latency (scan + decode + "
             "replay enqueue).",
         )
+        self._cluster_partitions = r.counter(
+            "uigc_cluster_partitions_total",
+            "Split-brain verdicts settled by the membership arbiter "
+            "(cluster/membership.py), by survived.",
+        )
+        self._sbr_downed = r.counter(
+            "uigc_sbr_downed_total",
+            "Nodes that downed themselves on a losing split-brain "
+            "verdict, by strategy.",
+        )
+        self._fence_rejected = r.counter(
+            "uigc_fence_rejected_total",
+            "Work refused by an epoch-fencing site (stale-era journal "
+            "appends, recovery conflicts, mig/sgrant frames, "
+            "quarantined routing), by site.",
+        )
+        self._membership_disagreements = r.counter(
+            "uigc_membership_disagreements_total",
+            "Live peers observed serving alongside a member this node "
+            "downed (the split_brain_suspected alert input).",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -670,6 +691,18 @@ class EventMetricsBridge:
             self._journal_recovered.inc()
             if duration is not None:
                 self._journal_replay_seconds.observe(duration)
+        elif name == events.SBR_DECISION:
+            self._cluster_partitions.inc(
+                survived=str(bool(fields.get("survived"))).lower()
+            )
+        elif name == events.SBR_DOWNED:
+            self._sbr_downed.inc(strategy=fields.get("strategy", "?"))
+        elif name == events.FENCE_REJECTED:
+            self._fence_rejected.inc(
+                fields.get("count", 1) or 1, site=fields.get("site", "?")
+            )
+        elif name == events.MEMBERSHIP_DISAGREEMENT:
+            self._membership_disagreements.inc()
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:

@@ -217,6 +217,40 @@ SHARD_BUFFER_DROPPED = "shard.buffer_dropped"
 NODE_DRAINING = "fabric.node_draining"
 NODE_DRAINED = "fabric.node_drained"
 
+# Partition-tolerance events (uigc_tpu/cluster/membership.py + the
+# epoch-fencing sites, PR 13):
+#   cluster.sbr_decision      the split-brain resolver reached a verdict
+#                             after the settle window (fields: strategy,
+#                             survived, downed, live, seen, fence) —
+#                             counts into uigc_cluster_partitions_total
+#   cluster.sbr_downed        this node LOST the verdict and is downing
+#                             itself (fields: strategy, downed_with) —
+#                             uigc_sbr_downed_total{strategy}
+#   cluster.sbr_quarantine    the losing side finished draining its
+#                             entities to the journal and stopped
+#                             serving (fields: entities, checkpointed)
+#   cluster.sbr_rejoin        a quarantined node adopted a survivor's
+#                             fence and re-entered the cluster (fields:
+#                             fence, via)
+#   cluster.fence_rejected    an epoch-fencing site refused stale work
+#                             (fields: site="journal"|"recovery"|"mig"|
+#                             "sgrant"|"route"|"ent", plus evidence) —
+#                             uigc_fence_rejected_total{site}
+#   cluster.membership_disagreement  two live peers' membership views
+#                             conflict (one lists as live a node the
+#                             other declared dead) — the
+#                             split_brain_suspected alert's input
+#   fabric.link_healed        a same-incarnation peer reconnected after
+#                             MemberRemoved and was re-admitted with a
+#                             fresh stream (fields: address)
+SBR_DECISION = "cluster.sbr_decision"
+SBR_DOWNED = "cluster.sbr_downed"
+SBR_QUARANTINE = "cluster.sbr_quarantine"
+SBR_REJOIN = "cluster.sbr_rejoin"
+FENCE_REJECTED = "cluster.fence_rejected"
+MEMBERSHIP_DISAGREEMENT = "cluster.membership_disagreement"
+LINK_HEALED = "fabric.link_healed"
+
 # Telemetry self-observation (uigc_tpu/telemetry):
 #   telemetry.listener_error  a recorder listener raised during dispatch;
 #                             fields: listener, event, error.  Counted so
