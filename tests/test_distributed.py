@@ -1,0 +1,861 @@
+"""Distributed collector: the partitioned shadow graph and its wave
+protocol (engines/crgc/distributed.py + parallel/partition.py).
+
+Layers:
+
+- unit: partition map churn/alignment, reduction-tree shape, the
+  dmark-family frame codecs (tolerance contract), fence-keyed ingress
+  windows and the undo log's straggler filter (the gateways satellite),
+  and the fold-locality audit (the UL014 runtime twin);
+- cluster: 3-node in-process fabric — a garbage cycle spanning all
+  three nodes collects with NO node ever holding the full graph,
+  verdicts identical to the single-host collector on the same
+  workload, merged-oracle uigcsan clean;
+- chaos: 3-node NodeFabric over real sockets — seeded dmark drops
+  (cumulative re-send until ack heals them) and a silent node kill
+  mid-collection (heartbeat verdict -> fence bump -> partition
+  ownership transfer -> journal re-fold), survivors sanitizer-clean.
+"""
+
+import time
+import types
+
+import pytest
+
+from uigc_tpu import AbstractBehavior, Behaviors, Message, NoRefs, PostStop
+from uigc_tpu.analysis.sanitizer import cross_check_distributed, merged_oracle
+from uigc_tpu.engines.crgc.delta import DeltaGraph
+from uigc_tpu.engines.crgc.distributed import PartitionedShadowGraph
+from uigc_tpu.engines.crgc.gateways import IngressEntry
+from uigc_tpu.engines.crgc.state import CrgcContext
+from uigc_tpu.engines.crgc.undo import UndoLog
+from uigc_tpu.parallel.partition import PartitionMap, ReductionTree, cell_key
+from uigc_tpu.runtime import wire
+from uigc_tpu.runtime.behaviors import RawBehavior
+from uigc_tpu.runtime.fabric import Fabric
+from uigc_tpu.runtime.faults import FaultPlan
+from uigc_tpu.runtime.node import NodeFabric
+from uigc_tpu.runtime.remote import RemoteSpawner
+from uigc_tpu.runtime.system import ActorSystem
+from uigc_tpu.runtime.testkit import TestProbe
+from uigc_tpu.utils import events
+
+BASE = {
+    "uigc.crgc.wakeup-interval": 10,
+    "uigc.crgc.egress-finalize-interval": 5,
+    "uigc.crgc.num-nodes": 3,
+    "uigc.crgc.distributed": True,
+    "uigc.analysis.sanitizer": True,
+}
+
+
+# ------------------------------------------------------------------- #
+# Workload actors (module-level: they cross pickling fabrics)
+# ------------------------------------------------------------------- #
+
+
+class Hold(Message):
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,) if self.ref is not None else ()
+
+
+class Go(NoRefs):
+    def __init__(self, rings, kept=0):
+        self.rings = rings
+        self.kept = kept
+
+
+class Drop(NoRefs):
+    pass
+
+
+class Spawned(NoRefs):
+    pass
+
+
+class Stopped(NoRefs):
+    pass
+
+
+class ProbeForwarder(RawBehavior):
+    def __init__(self, probe):
+        self.probe = probe
+
+    def on_message(self, msg):
+        self.probe._offer(msg)
+        return None
+
+
+class Worker(AbstractBehavior):
+    def __init__(self, context, probe_ref):
+        super().__init__(context)
+        self.probe_ref = probe_ref
+        self.held = []
+        probe_ref.tell(Spawned())
+
+    def on_message(self, msg):
+        if isinstance(msg, Hold):
+            self.held.append(msg.ref)
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe_ref.tell(Stopped())
+        return None
+
+
+class RingMaster(AbstractBehavior):
+    """Spawns rings of workers, one per node via the spawner services:
+    every ring is a reference cycle spanning the whole cluster.  Kept
+    rings stay pinned by the master's own refs (the over-collection
+    canary); dropped rings are garbage only the cross-node trace can
+    prove dead."""
+
+    def __init__(self, context, spawners):
+        super().__init__(context)
+        self.spawners = spawners
+        self.workers = []
+        self.kept = []
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, Go):
+            for r in range(msg.rings + msg.kept):
+                ring = [ctx.spawn_remote("worker", sc) for sc in self.spawners]
+                n = len(ring)
+                for i, w in enumerate(ring):
+                    nxt = ring[(i + 1) % n]
+                    w.tell(Hold(ctx.create_ref(nxt, w)), ctx)
+                (self.kept if r >= msg.rings else self.workers).extend(ring)
+        elif isinstance(msg, Drop):
+            for w in self.workers:
+                ctx.release(w)
+            self.workers = []
+        return self
+
+
+# ------------------------------------------------------------------- #
+# Cluster builders
+# ------------------------------------------------------------------- #
+
+
+def build_inproc(probe, overrides=None, nodes=3):
+    config = dict(BASE)
+    config["uigc.crgc.num-nodes"] = nodes
+    if overrides:
+        config.update(overrides)
+    fabric = Fabric()
+    systems = [
+        ActorSystem(None, name=f"dn{i}", config=config, fabric=fabric)
+        for i in range(nodes)
+    ]
+    spawners = [
+        RemoteSpawner.spawn_service(
+            s, {"worker": Behaviors.setup(lambda ctx: Worker(ctx, probe.ref))}
+        )
+        for s in systems
+    ]
+    master = systems[0].spawn_root(
+        Behaviors.setup_root(lambda ctx: RingMaster(ctx, spawners)), "master"
+    )
+    return systems, master
+
+
+class _Node:
+    __slots__ = ("fabric", "system", "port", "address")
+
+    def __init__(self, name, config, plan):
+        self.fabric = NodeFabric(fault_plan=plan)
+        self.system = ActorSystem(None, name=name, config=config, fabric=self.fabric)
+        self.port = self.fabric.listen()
+        self.address = self.system.address
+
+
+def build_nodefabric(names, probe, plan=None, overrides=None):
+    """3 NodeFabrics over localhost sockets; the probe forwarder and
+    each node's spawner service are registered as well-known names
+    BEFORE the mesh connects (names ride the hello)."""
+    config = dict(BASE)
+    config["uigc.crgc.num-nodes"] = len(names)
+    if overrides:
+        config.update(overrides)
+    nodes = [_Node(n, config, plan) for n in names]
+    probe_cell = nodes[0].system.spawn_system_raw(
+        ProbeForwarder(probe), "probe-fwd"
+    )
+    nodes[0].fabric.register_name("probe", probe_cell)
+    addr0 = nodes[0].address
+    for n in nodes:
+        if n is nodes[0]:
+            factory = Behaviors.setup(lambda ctx: Worker(ctx, probe_cell))
+        else:
+            fab = n.fabric
+
+            def factory_for(fab=fab):
+                return Behaviors.setup(
+                    lambda ctx: Worker(ctx, fab.lookup(addr0, "probe"))
+                )
+
+            factory = factory_for()
+        sc = RemoteSpawner.spawn_service(n.system, {"worker": factory})
+        n.fabric.register_name("spawner", sc)
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1 :]:
+            a.fabric.connect("127.0.0.1", b.port)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all(len(n.fabric.members()) == len(names) for n in nodes):
+            break
+        time.sleep(0.02)
+    spawners = [
+        nodes[0].fabric.lookup(n.address, "spawner") if n is not nodes[0]
+        else n.fabric._names["spawner"]
+        for n in nodes
+    ]
+    master = nodes[0].system.spawn_root(
+        Behaviors.setup_root(lambda ctx: RingMaster(ctx, spawners)), "master"
+    )
+    return nodes, master
+
+
+def terminate_all(items):
+    for it in items:
+        system = getattr(it, "system", it)
+        try:
+            system.terminate(timeout_s=5.0)
+        except Exception:
+            pass
+
+
+def collect_stopped(probe, expected, timeout_s=30.0):
+    stopped = 0
+    deadline = time.monotonic() + timeout_s
+    while stopped < expected and time.monotonic() < deadline:
+        try:
+            probe.expect_message_type(Stopped, timeout_s=2.0)
+            stopped += 1
+        except AssertionError:
+            continue
+    return stopped
+
+
+class EventLog:
+    def __init__(self):
+        import threading
+
+        self.entries = []
+        self._lock = threading.Lock()
+
+    def __call__(self, name, fields):
+        with self._lock:
+            self.entries.append((name, dict(fields)))
+
+    def names(self):
+        with self._lock:
+            return [n for n, _ in self.entries]
+
+    def of(self, name):
+        with self._lock:
+            return [f for n, f in self.entries if n == name]
+
+
+@pytest.fixture
+def event_log():
+    log = EventLog()
+    events.recorder.enable()
+    events.recorder.add_listener(log)
+    yield log
+    events.recorder.disable()
+    events.recorder.remove_listener(log)
+    events.recorder.reset()
+
+
+# ------------------------------------------------------------------- #
+# Unit layer
+# ------------------------------------------------------------------- #
+
+
+class _FakeCell:
+    """Identity-hashed stand-in exposing the (system.address, uid)
+    coordinate every graph-level API reads."""
+
+    __slots__ = ("system", "uid", "path")
+
+    def __init__(self, address, uid):
+        self.system = types.SimpleNamespace(address=address)
+        self.uid = uid
+        self.path = f"{address}/fake-{uid}"
+
+
+def _fake_cell(address, uid):
+    return _FakeCell(address, uid)
+
+
+def test_partition_map_minimal_churn_and_coverage():
+    members = ["uigc://a", "uigc://b", "uigc://c"]
+    pmap = PartitionMap(members, 32, fence=0, self_address="uigc://a")
+    # Complete coverage, deterministic in the member set.
+    owners = pmap.assignments()
+    assert sorted(owners) == list(range(32))
+    assert set(owners.values()) <= set(members)
+    again = PartitionMap(list(reversed(members)), 32, self_address="uigc://a")
+    assert again.assignments() == owners
+    # A death moves ONLY the dead node's partitions (rendezvous).
+    survivors = PartitionMap(
+        ["uigc://a", "uigc://b"], 32, fence=1, self_address="uigc://a"
+    )
+    moved = survivors.moved_partitions(pmap)
+    assert moved == pmap.owned_partitions("uigc://c")
+    for p in moved:
+        assert survivors.owner(p) in ("uigc://a", "uigc://b")
+    # Key routing is stable and owner-consistent.
+    key = ("uigc://a", 1234)
+    assert pmap.partition_of(key) == pmap.partition_of(key)
+    assert pmap.owner_of(key) == owners[pmap.partition_of(key)]
+
+
+def test_reduction_tree_shape_and_reroot():
+    members = sorted(f"uigc://n{i}" for i in range(7))
+    tree = ReductionTree(members)
+    assert tree.root == members[0]
+    # parent/children are mutually consistent and cover everyone once.
+    seen = []
+    for m in members:
+        for c in tree.children(m):
+            assert tree.parent(c) == m
+            seen.append(c)
+    assert sorted(seen + [tree.root]) == members
+    assert tree.subtree_size(tree.root) == len(members)
+    # Root death: the recomputed tree re-roots with no handoff protocol.
+    rebuilt = ReductionTree(members[1:])
+    assert rebuilt.root == members[1]
+    assert rebuilt.subtree_size(rebuilt.root) == len(members) - 1
+
+
+def test_dist_frame_codecs_round_trip_and_tolerance():
+    keys = [("uigc://a", 7), ("uigc://b", 123456789)]
+    pairs = [(("uigc://a", 1), ("uigc://b", 2))]
+    stats = {"settled": True, "changed": False, "sent": 3, "recv": 3, "nodes": 2}
+    cases = [
+        (wire.encode_dwave(4, 1, "uigc://a"), wire.decode_dwave, (4, 1, "uigc://a")),
+        (
+            wire.encode_dmark(4, 1, "uigc://a", keys),
+            wire.decode_dmark,
+            (4, 1, "uigc://a", keys),
+        ),
+        # The ack/round frames carry a trailing fence: absent (an older
+        # peer) decodes as era 0, explicit values round-trip.
+        (
+            wire.encode_dmack(4, "uigc://a", 9),
+            wire.decode_dmack,
+            (4, "uigc://a", 9, 0),
+        ),
+        (
+            wire.encode_dmack(4, "uigc://a", 9, fence=3),
+            wire.decode_dmack,
+            (4, "uigc://a", 9, 3),
+        ),
+        (
+            wire.encode_dprobe(4, 2, "uigc://a"),
+            wire.decode_dprobe,
+            (4, 2, "uigc://a", 0),
+        ),
+        (
+            wire.encode_dstat(4, 2, "uigc://a", stats),
+            wire.decode_dstat,
+            (4, 2, "uigc://a", stats, 0),
+        ),
+        (wire.encode_dfin(4, 1, "uigc://a"), wire.decode_dfin, (4, 1, "uigc://a")),
+        (
+            wire.encode_dgate(4, 1, "uigc://a", pairs),
+            wire.decode_dgate,
+            (4, 1, "uigc://a", pairs),
+        ),
+        (
+            wire.encode_dgack(4, "uigc://a", 1),
+            wire.decode_dgack,
+            (4, "uigc://a", 1, 0),
+        ),
+        (wire.encode_ddirty("uigc://a"), wire.decode_ddirty, "uigc://a"),
+        (
+            wire.encode_djournal(1, 5, b"graphbytes"),
+            wire.decode_djournal,
+            (1, 5, b"graphbytes"),
+        ),
+    ]
+    for frame, decode, expected in cases:
+        assert frame[0] in wire.DIST_FRAME_KINDS
+        assert decode(frame) == expected
+        # Trailing elements from a newer peer are tolerated.
+        assert decode(frame + ("future", 42)) == expected
+        # Truncation is malformed -> None, never a raise.
+        assert decode(frame[:1]) is None
+    # Corrupt payloads: bad json / wrong types degrade to None.
+    assert wire.decode_dmark(("dmark", 1, 1, "a", b"{not json")) is None
+    assert wire.decode_dmark(("dmark", 1, 1, "a", "not-bytes")) is None
+    assert wire.decode_dstat(("dstat", 1, 1, "a", b"[1,2]")) is None
+    assert wire.decode_dgate(("dgate", 1, 1, "a", b"[[1]]")) is None
+    assert wire.decode_djournal(("djnl", 1, 5, 42)) is None
+
+
+def test_ingress_entry_fence_wire_round_trip():
+    entry = IngressEntry()
+    entry.id = 3
+    entry.fence = 2
+    entry.nonce = 0xDEADBEEFCAFE
+    entry.egress_address = "uigc://dead"
+    entry.ingress_address = "uigc://obs"
+    cell = _fake_cell("uigc://obs", 77)
+    entry.on_message(cell, [])
+    tokens = {}
+
+    def encode_cell(c):
+        tokens[b"t"] = c
+        return b"t"
+
+    buf = entry.serialize(encode_cell)
+    back = IngressEntry.deserialize(buf, lambda b: tokens[b])
+    assert back.fence == 2 and back.id == 3
+    assert back.nonce == 0xDEADBEEFCAFE
+    assert back == entry
+    # A fence-only frame (peer predates the nonce) scans nonce 0.
+    fence_only = IngressEntry.deserialize(buf[:-8], lambda b: tokens[b])
+    assert fence_only.fence == 2 and fence_only.nonce == 0
+    # A legacy frame (neither trailing field) scans as era 0, nonce 0.
+    legacy = IngressEntry.deserialize(buf[:-12], lambda b: tokens[b])
+    assert legacy.fence == 0 and legacy.nonce == 0
+    assert legacy.admitted == entry.admitted
+
+
+def test_undo_log_refuses_pre_death_stragglers():
+    log = UndoLog("uigc://dead", fence=1, own_address="uigc://me")
+
+    def entry(ingress, fence, wid=0, final=False):
+        e = IngressEntry()
+        e.id = wid
+        e.fence = fence
+        e.egress_address = "uigc://dead"
+        e.ingress_address = ingress
+        e.is_final = final
+        return e
+
+    # Our own pre-rejoin straggler: below the creation floor -> stale.
+    assert log.stale_fence(entry("uigc://me", 0)) is True
+    assert log.stale_fence(entry("uigc://me", 1)) is False
+    # A peer's stream is judged only by its own monotonicity (its
+    # era counter is not comparable to ours).
+    assert log.stale_fence(entry("uigc://peer", 0)) is False
+    assert log.stale_fence(entry("uigc://peer", 1)) is False
+    assert log.stale_fence(entry("uigc://peer", 0)) is True
+    # Final entries from a stale era must not join the quorum.
+    stale_final = entry("uigc://me", 0, final=True)
+    assert log.stale_fence(stale_final) is True
+    assert "uigc://me" not in log.finalized_by
+
+
+def _straggler_entry(ingress, fence, recipient, n_msgs, wid=0, final=False):
+    e = IngressEntry()
+    e.id = wid
+    e.fence = fence
+    e.egress_address = "uigc://dead"
+    e.ingress_address = ingress
+    e.is_final = final
+    for _ in range(n_msgs):
+        e.on_message(recipient, [])
+    return e
+
+
+def test_undo_log_seeded_floors_fence_out_dead_eras():
+    """At a rejoin the new log inherits the superseded log's per-peer
+    eras as floors: a dead-era rebroadcast arriving FIRST after the
+    rejoin is refused even though the new log has never heard from that
+    peer."""
+    recipient = _fake_cell("uigc://me", 9)
+    old = UndoLog("uigc://dead", fence=0, own_address="uigc://me")
+    assert old.stale_fence(_straggler_entry("uigc://b", 0, recipient, 1)) is False
+    fresh = UndoLog("uigc://dead", fence=1, own_address="uigc://me")
+    fresh.seed_floors(old)
+    # b reported era 0 toward the dead incarnation -> era 0 is fenced.
+    assert fresh.stale_fence(_straggler_entry("uigc://b", 0, recipient, 1)) is True
+    assert fresh.stale_fence(_straggler_entry("uigc://b", 1, recipient, 1)) is False
+    # A peer the old log never heard from is still judged only by its
+    # own stream (late joiners legitimately run era 0).
+    assert fresh.stale_fence(_straggler_entry("uigc://c", 0, recipient, 1)) is False
+    # Floors survive a second rejoin via the intermediate log.
+    third = UndoLog("uigc://dead", fence=2, own_address="uigc://me")
+    third.seed_floors(fresh)
+    assert third.stale_fence(_straggler_entry("uigc://b", 0, recipient, 1)) is True
+
+
+def test_undo_log_nonce_refuses_other_incarnation_outright():
+    """The quorum-race closer: a straggler about a PREVIOUS incarnation
+    of the dead address — even a final, even as the first thing ever
+    heard from that observer — is refused by incarnation identity
+    before it can tally or satisfy the fold quorum.  No floor, no
+    watermark, no supersession wait."""
+    recipient = _fake_cell("uigc://me", 9)
+    log = UndoLog(
+        "uigc://dead", fence=1, own_address="uigc://me",
+        expected_nonce=0xA1,
+    )
+    stale = _straggler_entry("uigc://c", 0, recipient, 3, final=True)
+    stale.nonce = 0xA0  # the incarnation that died the time BEFORE
+    assert log.stale_fence(stale) is True
+    assert "uigc://c" not in log.finalized_by
+    genuine = _straggler_entry("uigc://c", 0, recipient, 2, final=True)
+    genuine.nonce = 0xA1  # a late joiner's era 0 IS the live stream
+    assert log.stale_fence(genuine) is False
+    log.merge_ingress_entry(genuine)
+    assert "uigc://c" in log.finalized_by
+    assert log.admitted[recipient].message_count == -2
+    # Nonce-less entries (old peers / in-process fabrics) fall back to
+    # the fence-era discipline rather than being refused.
+    legacy = _straggler_entry("uigc://d", 0, recipient, 1)
+    assert log.stale_fence(legacy) is False
+
+
+def test_undo_log_supersession_unmerges_stale_first_straggler():
+    """No floor on record (the peer's dead-era entries never arrived
+    before the rejoin): the stale entry merges, but the peer's first
+    live-era entry un-applies its tallies and withdraws its
+    finalization before landing."""
+    recipient = _fake_cell("uigc://me", 9)
+    log = UndoLog("uigc://dead", fence=1, own_address="uigc://me")
+    stale = _straggler_entry("uigc://b", 0, recipient, 3, wid=7, final=True)
+    assert log.stale_fence(stale) is False
+    log.merge_ingress_entry(stale)
+    assert "uigc://b" in log.finalized_by
+    assert log.admitted[recipient].message_count == -3
+    live = _straggler_entry("uigc://b", 1, recipient, 2, wid=0)
+    assert log.stale_fence(live) is False
+    log.merge_ingress_entry(live)
+    # Era-0 tallies and the era-0 final are gone; only era 1 remains.
+    assert "uigc://b" not in log.finalized_by
+    assert log.admitted[recipient].message_count == -2
+    live_final = _straggler_entry("uigc://b", 1, recipient, 1, wid=1, final=True)
+    log.merge_ingress_entry(live_final)
+    assert "uigc://b" in log.finalized_by
+    assert log.admitted[recipient].message_count == -3
+    # And the dead era can no longer sneak back in behind the live one.
+    assert log.stale_fence(_straggler_entry("uigc://b", 0, recipient, 5)) is True
+    # Retention is a bounded per-actor NET, not a window archive: a
+    # healthy link's continuous (and often empty) windows must not grow
+    # the log.  Empty windows retain nothing at all.
+    for wid in range(2, 52):
+        log.merge_ingress_entry(_straggler_entry("uigc://b", 1, recipient, 0, wid=wid))
+    assert len(log._applied_net.get("uigc://b", {})) <= 1
+    assert log._applied_counts.get("uigc://b", 0) == 2  # the two non-empty windows
+
+
+def test_ingress_windows_key_by_peer_fence():
+    """Same window id, different fence era -> different tallies (the
+    rejoined incarnation's stream never merges with its pre-death
+    windows)."""
+    from uigc_tpu.engines.crgc.gateways import Ingress
+    from uigc_tpu.engines.crgc.messages import AppMsg
+
+    sent = []
+
+    class FakeEngine:
+        def __init__(self):
+            self._fence = 0
+            self.bookkeeper_cell = types.SimpleNamespace(
+                tell=lambda msg: sent.append(msg.entry)
+            )
+
+        def link_fence(self, address):
+            return self._fence
+
+    link = types.SimpleNamespace(
+        src=types.SimpleNamespace(address="uigc://peer"),
+        dst=types.SimpleNamespace(address="uigc://me"),
+    )
+    engine = FakeEngine()
+    ingress = Ingress(link, engine)
+    recipient = _fake_cell("uigc://me", 5)
+    msg = AppMsg(None, (), None)
+    msg.window_id = 0
+    ingress.on_message(recipient, msg)
+    engine._fence = 1  # the peer died and rejoined
+    ingress.on_messages(recipient, [msg, msg])
+    assert sorted(ingress.open_windows()) == [(0, 0), (1, 0)]
+    old = ingress.entries[(0, 0)]
+    new = ingress.entries[(1, 0)]
+    assert old.fence == 0 and new.fence == 1
+    assert old.admitted[recipient].message_count == 1
+    assert new.admitted[recipient].message_count == 2
+    # Marker for window 0 closes the CURRENT era's window only.
+    ingress.finalize_window(0)
+    assert ingress.open_windows() == [(0, 0)]
+    assert sent[-1].fence == 1
+    # Link death flushes the stale era too, final entry in current era.
+    ingress.finalize_all(is_final=True)
+    assert sent[-1].is_final and sent[-1].fence == 1
+    assert ingress.open_windows() == []
+
+
+def test_fold_locality_audit_flags_foreign_fold():
+    """The UL014 runtime twin: a content-bearing fold landing outside
+    the owned slice is caught by the per-sweep audit."""
+    context = CrgcContext(delta_graph_size=64, entry_field_size=8)
+    g = PartitionedShadowGraph(context, "uigc://a")
+    pmap = PartitionMap(
+        ["uigc://a", "uigc://b"], 32, fence=0, self_address="uigc://a"
+    )
+    g.set_partition_map(pmap)
+    owned = foreign = None
+    for uid in range(200):
+        cell = _fake_cell("uigc://a", uid)
+        if pmap.owns(cell_key(cell)):
+            owned = owned or cell
+        else:
+            foreign = foreign or cell
+        if owned is not None and foreign is not None:
+            break
+    delta = DeltaGraph("uigc://a", context)
+    delta.fold_self(owned, 0, False, False)
+    delta.fold_self(foreign, 1, False, False)
+    g.merge_delta(delta)
+    bad = g.audit_fold_locality()
+    assert bad == [cell_key(foreign)]
+    # The audit window cleared; an owned-only fold stays clean.
+    delta2 = DeltaGraph("uigc://a", context)
+    delta2.fold_self(owned, 0, True, False)
+    g.merge_delta(delta2)
+    assert g.audit_fold_locality() == []
+
+
+def test_ul014_flags_out_of_fold_slot_mutation(tmp_path):
+    """Lint rule UL014, both directions: a rogue module mutating shadow
+    slots outside the fold plane is flagged; the real fold-plane
+    modules stay clean."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent / "tools"))
+    try:
+        from uigc_lint import lint_paths
+    finally:
+        sys.path.pop(0)
+
+    rogue_dir = tmp_path / "uigc_tpu" / "engines" / "crgc"
+    rogue_dir.mkdir(parents=True)
+    (rogue_dir / "rogue.py").write_text(
+        "def f(shadow, other):\n"
+        "    shadow.is_halted = True\n"
+        "    shadow.recv_count += 1\n"
+        "    shadow.outgoing[other] = 2\n"
+    )
+    hits = [v for v in lint_paths([str(tmp_path)]) if v.rule == "UL014"]
+    assert len(hits) == 3
+    repo = __import__("pathlib").Path(__file__).parent.parent
+    clean = [
+        v
+        for v in lint_paths(
+            [
+                str(repo / "uigc_tpu" / "engines" / "crgc" / "distributed.py"),
+                str(repo / "uigc_tpu" / "parallel" / "partition.py"),
+            ]
+        )
+        if v.rule == "UL014"
+    ]
+    assert clean == []
+
+
+# ------------------------------------------------------------------- #
+# Cluster layer (in-process fabric)
+# ------------------------------------------------------------------- #
+
+
+def test_three_node_cycle_collected_without_full_replica(event_log):
+    """The acceptance core: a garbage cycle spanning all three nodes is
+    detected while no node ever folds the full graph, every fold stays
+    inside the owned slice, and the merged per-node oracles confirm
+    every sweep verdict."""
+    probe = TestProbe(default_timeout_s=20.0)
+    systems, master = build_inproc(probe)
+    rings, kept = 5, 2
+    total = (rings + kept) * 3
+    try:
+        master.tell(Go(rings, kept))
+        for _ in range(total):
+            probe.expect_message_type(Spawned)
+        time.sleep(0.4)
+        master.tell(Drop())
+        stopped = collect_stopped(probe, rings * 3)
+        assert stopped == rings * 3
+        # Kept rings survive (the over-collection canary).
+        probe.expect_no_message(0.5)
+        # No node ever held the full graph: the global population is
+        # the kept workers + spawners + master + already-swept slop;
+        # each node's slice must be strictly smaller than the cluster
+        # total of live + kept actors.
+        pops = [
+            len(s.engine.bookkeeper.shadow_graph.from_set) for s in systems
+        ]
+        owned = [
+            s.engine.bookkeeper.shadow_graph.owned_population()
+            for s in systems
+        ]
+        assert sum(owned) >= kept * 3
+        for pop, own in zip(pops, owned):
+            assert own <= pop
+            assert own < sum(owned)
+        # Every node's folds stayed inside its owned slice.
+        assert not event_log.of(events.DIST_LOCALITY)
+        for s in systems:
+            assert s.engine.bookkeeper.shadow_graph.audit_fold_locality() == []
+        # The wave protocol actually ran cross-node.
+        assert event_log.of(events.DIST_WAVE)
+        assert event_log.of(events.DIST_MARKS)
+        assert event_log.of(events.DIST_ROUND)
+        # Distributed uigcsan: merged oracle agrees with every verdict.
+        time.sleep(0.3)
+        merged = merged_oracle(systems)
+        assert len(merged.garbage) >= rings * 3
+        assert cross_check_distributed(systems) == []
+        for s in systems:
+            assert s.sanitizer.violations == []
+            assert s.sanitizer.dist_sweeps > 0
+    finally:
+        terminate_all(systems)
+
+
+def test_verdict_parity_with_single_host():
+    """The same workload on the partitioned 3-node collector and on a
+    single-host collector: every actor gets the identical verdict
+    (dropped rings collected, kept rings alive)."""
+    rings, kept = 4, 2
+
+    def run(distributed):
+        probe = TestProbe(default_timeout_s=20.0)
+        if distributed:
+            systems, master = build_inproc(probe)
+        else:
+            config = dict(BASE)
+            config["uigc.crgc.num-nodes"] = 1
+            config["uigc.crgc.distributed"] = False
+            config["uigc.crgc.shadow-graph"] = "oracle"
+            system = ActorSystem(None, name="solo", config=config)
+            systems = [system]
+            spawner = RemoteSpawner.spawn_service(
+                system,
+                {"worker": Behaviors.setup(lambda ctx: Worker(ctx, probe.ref))},
+            )
+            master = system.spawn_root(
+                Behaviors.setup_root(
+                    lambda ctx: RingMaster(ctx, [spawner] * 3)
+                ),
+                "master",
+            )
+        try:
+            master.tell(Go(rings, kept))
+            for _ in range((rings + kept) * 3):
+                probe.expect_message_type(Spawned)
+            time.sleep(0.4)
+            master.tell(Drop())
+            stopped = collect_stopped(probe, rings * 3)
+            probe.expect_no_message(0.5)
+            return stopped
+        finally:
+            terminate_all(systems)
+
+    assert run(distributed=True) == run(distributed=False) == rings * 3
+
+
+# ------------------------------------------------------------------- #
+# Chaos layer (NodeFabric over real sockets)
+# ------------------------------------------------------------------- #
+
+
+def test_nodefabric_dmark_drops_tolerated(event_log):
+    """Seeded drops on the dmark/dmack frames: the cumulative-set
+    re-send converges anyway and the verdicts stay sanitizer-clean."""
+    plan = FaultPlan(1234)
+    names = ["dda", "ddb", "ddc"]
+    probe = TestProbe(default_timeout_s=30.0)
+    nodes, master = build_nodefabric(names, probe, plan=plan)
+    addrs = [n.address for n in nodes]
+    for src in addrs:
+        for dst in addrs:
+            if src != dst:
+                plan.drop(src=src, dst=dst, kind="dmark", prob=0.35)
+                plan.drop(src=src, dst=dst, kind="dmack", prob=0.35)
+    rings = 4
+    try:
+        master.tell(Go(rings))
+        for _ in range(rings * 3):
+            probe.expect_message_type(Spawned)
+        time.sleep(0.5)
+        master.tell(Drop())
+        stopped = collect_stopped(probe, rings * 3, timeout_s=40.0)
+        assert stopped == rings * 3
+        dropped = [
+            f
+            for f in event_log.of(events.FRAME_DROPPED)
+            if f.get("kind") in ("dmark", "dmack")
+        ]
+        assert dropped, "the fault plan never actually dropped a dmark"
+        for n in nodes:
+            assert n.system.sanitizer.violations == []
+        assert cross_check_distributed([n.system for n in nodes]) == []
+    finally:
+        terminate_all(nodes)
+
+
+def test_nodefabric_node_death_absorbs_partition(event_log):
+    """A node dies silently mid-collection: the heartbeat verdict bumps
+    the fence, ownership of its partitions transfers by rendezvous, the
+    survivors re-fold their retained journals, and the surviving
+    members of every broken ring collect — sanitizer-clean throughout."""
+    names = ["nka", "nkb", "nkc"]
+    probe = TestProbe(default_timeout_s=30.0)
+    nodes, master = build_nodefabric(
+        names,
+        probe,
+        overrides={
+            "uigc.node.heartbeat-interval": 40,
+            "uigc.node.phi-threshold": 6.0,
+            "uigc.node.heartbeat-pause": 400,
+        },
+    )
+    a, b, c = nodes
+    rings = 4
+    try:
+        master.tell(Go(rings))
+        for _ in range(rings * 3):
+            probe.expect_message_type(Spawned)
+        time.sleep(0.5)
+        fences_before = [
+            n.system.engine.bookkeeper.fence for n in (a, b)
+        ]
+        master.tell(Drop())
+        # Kill c immediately: the drop's collection waves are in flight.
+        c.fabric.die()
+        # The dead node's workers die with it; the survivors' ring
+        # members must still collect once the undo fold reverts c's
+        # claims and the absorb re-folds its partitions.
+        stopped = collect_stopped(probe, rings * 2, timeout_s=60.0)
+        assert stopped >= rings * 2
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(
+                c.address not in n.fabric.members() for n in (a, b)
+            ):
+                break
+            time.sleep(0.05)
+        for i, n in enumerate((a, b)):
+            bk = n.system.engine.bookkeeper
+            assert c.address not in bk.pmap.members
+            assert bk.fence > fences_before[i]
+            # Ownership covers the whole space between the survivors.
+            owners = set(bk.pmap.assignments().values())
+            assert owners <= {a.address, b.address}
+        for n in (a, b):
+            assert n.system.sanitizer.violations == []
+        assert cross_check_distributed([a.system, b.system]) == []
+    finally:
+        terminate_all(nodes)

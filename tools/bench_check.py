@@ -112,6 +112,26 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
             Metric("partition.dual_active_keys", "zero", 0.0),
         ],
     ),
+    # Distributed collector (tools/dist_bench.py): 3-node partitioned
+    # trace over cross-node garbage cycles.  leaked_actors is a hard
+    # zero — a cycle the wave protocol cannot close is a soundness
+    # regression, not jitter; throughput gets the usual wide band, and
+    # the locality fraction is a structural property of the workload
+    # (gated loosely so a full-replica regression — fraction ~1.0 —
+    # fails while placement jitter passes).
+    "DIST": (
+        "BENCH_DIST_r*.json",
+        [
+            Metric("trace.garbage_actors_per_sec", "higher", 0.40),
+            Metric("trace.leaked_actors", "zero", 0.0),
+            # Authoritative slots only: a hub actor's owner also holds
+            # bare mirrors of everything the hub references, so the
+            # resident-population fraction legitimately nears 1.0 on
+            # the single-master workload — the replica regression the
+            # band exists to catch shows up in the OWNED fraction.
+            Metric("locality.max_node_owned_fraction", "lower", 0.60),
+        ],
+    ),
     # Device plane (telemetry/device.py + tools/device_report.py): the
     # TPU-session artifacts gate the same figures the wake-budget
     # explainer decomposes.  Rounds that predate wake_chain_bench (or
@@ -216,12 +236,49 @@ def check_family(
     runs = trajectory(repo, pattern)
     rows: List[Dict[str, Any]] = []
     if len(runs) < 2 and not (newest_override and runs):
-        rows.append(
-            {
-                "family": family, "metric": "-", "status": "SKIP",
-                "note": f"{len(runs)} committed run(s); need 2",
-            }
-        )
+        if not runs:
+            rows.append(
+                {
+                    "family": family, "metric": "-", "status": "SKIP",
+                    "note": "0 committed run(s); need 2",
+                }
+            )
+            return rows
+        # One committed round: no trajectory to band yet, but the
+        # zero-direction correctness floors are absolute — they must
+        # already hold on the debut round, or a nonzero tally would
+        # grandfather itself in as the future comparison baseline.
+        new_round, new_path = runs[-1]
+        new_doc = _load(new_path)
+        for metric in metrics:
+            if metric.direction != "zero":
+                rows.append(
+                    {
+                        "family": family, "metric": metric.path,
+                        "status": "SKIP",
+                        "note": "1 committed run(s); need 2",
+                    }
+                )
+                continue
+            new = _resolve(new_doc, metric.path) if new_doc else None
+            if new is None:
+                status, note = "SKIP", "metric missing in newest"
+            else:
+                status, note = compare_metric(metric, None, new)
+            rows.append(
+                {
+                    "family": family,
+                    "metric": metric.path,
+                    "prior": None,
+                    "new": new,
+                    "rounds": f"r{new_round:02d}",
+                    "delta": "",
+                    "tolerance": metric.tolerance,
+                    "direction": metric.direction,
+                    "status": status,
+                    "note": note,
+                }
+            )
         return rows
     if newest_override:
         prior_round, prior_path = runs[-1]

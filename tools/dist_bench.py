@@ -1,0 +1,286 @@
+"""Distributed-collector trace benchmark (engines/crgc/distributed.py).
+
+A 3-node cluster running the partitioned collector: a master on node 0
+spawns rings of workers — one worker per node, each holding a ref to
+the next node's worker, so every ring is a garbage cycle that SPANS ALL
+THREE NODES and no node's owned slice can prove it dead alone — then
+drops every ring at once and times the distributed wave protocol
+collecting them (boundary dmark exchange + Safra termination rounds,
+no full-graph replica anywhere).
+
+Reported:
+
+- ``trace.garbage_actors_per_sec`` — cross-node garbage collected per
+  second, drop to last PostStop (the headline figure);
+- ``trace.boundary_mark_bytes_per_wave`` / ``trace.rounds_per_wave`` —
+  the protocol's per-wave wire surface and termination cost;
+- ``locality.max_node_population_fraction`` — the largest share of the
+  global shadow population any single node held (owned + mirrors):
+  materially below 1.0 is the whole point of the subsystem;
+- ``replicated.garbage_actors_per_sec`` — the same workload on the
+  replicated (full-copy) collector, for an apples-to-apples floor.
+
+Prints one JSON object; commit as ``BENCH_DIST_r{N}.json`` (the
+bench_check DIST family bands ``trace.garbage_actors_per_sec`` and
+hard-zeroes ``trace.leaked_actors``).
+
+Usage: python tools/dist_bench.py [--rings 120] [--smoke] [--json PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from uigc_tpu import (  # noqa: E402
+    AbstractBehavior,
+    Behaviors,
+    Message,
+    NoRefs,
+    PostStop,
+)
+
+BASE = {
+    "uigc.crgc.wakeup-interval": 10,
+    "uigc.crgc.egress-finalize-interval": 10,
+    "uigc.crgc.num-nodes": 3,
+}
+
+NODES = 3
+
+
+class Hold(Message):
+    """Hand a worker the ref that closes its ring (wire-crossing)."""
+
+    def __init__(self, ref):
+        self.ref = ref
+
+    @property
+    def refs(self):
+        return (self.ref,) if self.ref is not None else ()
+
+
+class Go(NoRefs):
+    def __init__(self, rings: int):
+        self.rings = rings
+
+
+class Drop(NoRefs):
+    pass
+
+
+class Spawned(NoRefs):
+    pass
+
+
+class Stopped(NoRefs):
+    pass
+
+
+class Worker(AbstractBehavior):
+    def __init__(self, context, probe_ref):
+        super().__init__(context)
+        self.probe_ref = probe_ref
+        self.held = []
+        probe_ref.tell(Spawned())
+
+    def on_message(self, msg):
+        if isinstance(msg, Hold):
+            self.held.append(msg.ref)
+        return self
+
+    def on_signal(self, signal):
+        if signal is PostStop:
+            self.probe_ref.tell(Stopped())
+        return None
+
+
+class Master(AbstractBehavior):
+    def __init__(self, context, spawners):
+        super().__init__(context)
+        self.spawners = spawners
+        self.workers = []
+
+    def on_message(self, msg):
+        ctx = self.context
+        if isinstance(msg, Go):
+            for _ in range(msg.rings):
+                ring = [ctx.spawn_remote("worker", sc) for sc in self.spawners]
+                n = len(ring)
+                for i, w in enumerate(ring):
+                    nxt = ring[(i + 1) % n]
+                    w.tell(Hold(ctx.create_ref(nxt, w)), ctx)
+                self.workers.extend(ring)
+        elif isinstance(msg, Drop):
+            for w in self.workers:
+                ctx.release(w)
+            self.workers = []
+        return self
+
+
+def _build(distributed: bool, probe):
+    from uigc_tpu.runtime.fabric import Fabric
+    from uigc_tpu.runtime.remote import RemoteSpawner
+    from uigc_tpu.runtime.system import ActorSystem
+
+    config = dict(BASE)
+    config["uigc.crgc.distributed"] = distributed
+    fabric = Fabric()
+    systems = [
+        ActorSystem(None, name=f"dist{i}", config=config, fabric=fabric)
+        for i in range(NODES)
+    ]
+    spawners = [
+        RemoteSpawner.spawn_service(
+            s, {"worker": Behaviors.setup(lambda ctx: Worker(ctx, probe.ref))}
+        )
+        for s in systems
+    ]
+    master = systems[0].spawn_root(
+        Behaviors.setup_root(lambda ctx: Master(ctx, spawners)), "master"
+    )
+    return systems, master
+
+
+def _run_phase(rings: int, distributed: bool, timeout_s: float) -> dict:
+    from uigc_tpu.runtime.testkit import TestProbe
+
+    probe = TestProbe(default_timeout_s=timeout_s)
+    systems, master = _build(distributed, probe)
+    total = rings * NODES
+    try:
+        master.tell(Go(rings))
+        for _ in range(total):
+            probe.expect_message_type(Spawned)
+        # Let the held refs' entries reach every owner before the drop.
+        time.sleep(0.3)
+        peak_pop = [0] * NODES
+        peak_owned = [0] * NODES
+        if distributed:
+            # Steady-state sample BEFORE the drop: this is the moment
+            # every ring is resident, so a full-replica regression
+            # (owned fraction ~1.0) cannot hide behind post-sweep
+            # sampling.  Note the master is a hub: its owner also holds
+            # a bare MIRROR for every worker it spawned (endpoints of
+            # the master's own edge list), so resident population on
+            # that one node approaches the global count by design —
+            # the ownership claim is about authoritative slots, which
+            # is what the owned fraction measures and the band gates.
+            for i, s in enumerate(systems):
+                g = s.engine.bookkeeper.shadow_graph
+                peak_pop[i] = max(peak_pop[i], len(g.from_set))
+                peak_owned[i] = max(peak_owned[i], g.owned_population())
+        t0 = time.monotonic()
+        master.tell(Drop())
+        stopped = 0
+        deadline = t0 + timeout_s
+        while stopped < total and time.monotonic() < deadline:
+            try:
+                probe.expect_message_type(Stopped)
+                stopped += 1
+            except Exception:
+                break
+            if distributed and stopped % 50 == 0:
+                for i, s in enumerate(systems):
+                    g = s.engine.bookkeeper.shadow_graph
+                    peak_pop[i] = max(peak_pop[i], len(g.from_set))
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        if distributed:
+            for i, s in enumerate(systems):
+                g = s.engine.bookkeeper.shadow_graph
+                peak_pop[i] = max(peak_pop[i], len(g.from_set))
+        out = {
+            "rings": rings,
+            "garbage_actors": stopped,
+            "leaked_actors": total - stopped,
+            "seconds": round(elapsed, 4),
+            "garbage_actors_per_sec": round(stopped / elapsed, 1),
+        }
+        if distributed:
+            dumps = [
+                s.engine.bookkeeper.diagnostic_dump().get("distributed", {})
+                for s in systems
+            ]
+            waves = max(1, max(d.get("waves_completed", 0) for d in dumps))
+            out["waves"] = waves
+            out["marks_sent"] = sum(d.get("marks_sent", 0) for d in dumps)
+            out["mark_bytes"] = sum(d.get("mark_bytes", 0) for d in dumps)
+            out["boundary_mark_bytes_per_wave"] = round(
+                out["mark_bytes"] / waves, 1
+            )
+            out["rounds_total"] = sum(d.get("rounds_total", 0) for d in dumps)
+            out["rounds_per_wave"] = round(out["rounds_total"] / waves, 2)
+            out["boundary_edges_peak"] = max(
+                d.get("boundary_edges", 0) for d in dumps
+            )
+            # Workers + one spawner per node + the master; the probe
+            # rides its own system outside the cluster.
+            global_pop = rings * NODES + NODES + 1
+            out["node_peak_populations"] = peak_pop
+            out["node_peak_owned"] = peak_owned
+            out["max_node_population_fraction"] = round(
+                max(peak_pop) / max(global_pop, 1), 3
+            )
+            out["max_node_owned_fraction"] = round(
+                max(peak_owned) / max(global_pop, 1), 3
+            )
+        return out
+    finally:
+        for s in systems:
+            s.terminate(timeout_s=10)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--rings", type=int, default=120)
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="small verify-gate run: a few rings, asserts zero leaks",
+    )
+    parser.add_argument("--json", type=str, default=None)
+    args = parser.parse_args()
+
+    rings = 6 if args.smoke else args.rings
+    timeout_s = 60.0 if args.smoke else 180.0
+    dist = _run_phase(rings, distributed=True, timeout_s=timeout_s)
+    result = {
+        "bench": "dist",
+        "nodes": NODES,
+        "smoke": bool(args.smoke),
+        "trace": dist,
+        "locality": {
+            "max_node_owned_fraction": dist.pop(
+                "max_node_owned_fraction", None
+            ),
+            "max_node_population_fraction": dist.pop(
+                "max_node_population_fraction", None
+            ),
+            "node_peak_owned": dist.pop("node_peak_owned", None),
+            "node_peak_populations": dist.pop("node_peak_populations", None),
+        },
+    }
+    if not args.smoke:
+        repl = _run_phase(rings, distributed=False, timeout_s=timeout_s)
+        result["replicated"] = repl
+    text = json.dumps(result, indent=2, sort_keys=True)
+    print(text)
+    if args.json:
+        Path(args.json).write_text(text + "\n")
+    if dist["leaked_actors"]:
+        print(
+            f"FAIL: {dist['leaked_actors']} of {rings * NODES} "
+            "cross-node cycle actors never collected",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,6 +163,8 @@ RULES = {
     "without a bound or an '# unbounded:' rationale",
     "UL013": "journal append or shard-table mutation bypassing the "
     "fenced helpers in cluster/sharding.py / cluster/journal.py",
+    "UL014": "shadow-graph slot mutated outside the owning partition's "
+    "fold path (route through the dmark/delta plane)",
 }
 
 #: UL012: attribute names that read as queues/buffers.  The rule fires
@@ -193,6 +195,21 @@ _JOURNAL_APPEND_CALLS = {
     "commit_snapshot",
     "begin_snapshot",
 }
+
+#: UL014: the authoritative shadow-slot attributes only the fold plane
+#: may write (the distributed collector's ownership contract: any other
+#: writer must route the fact through the dmark/delta plane so it lands
+#: at the owning partition), and the modules that ARE the fold plane.
+#: ``recv_count`` is gated on a shadow-named receiver because mutator
+#: entries legitimately carry a field of the same name.
+_SHADOW_SLOT_ATTRS = {"interned", "is_halted", "supervisor"}
+_SHADOW_FOLD_MODULES = (
+    "engines/crgc/shadow.py",
+    "engines/crgc/delta.py",
+    "engines/crgc/distributed.py",
+    "engines/crgc/state.py",
+    "analysis/sanitizer.py",
+)
 
 #: UL009: unit suffixes a counter or histogram name must end with.
 _METRIC_UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio")
@@ -364,6 +381,11 @@ class _FileLinter:
             norm.endswith("cluster/sharding.py")
             or norm.endswith("cluster/journal.py")
         )
+        slot_plane = (
+            "uigc_tpu" in parts
+            and "tests" not in parts
+            and not norm.endswith(_SHADOW_FOLD_MODULES)
+        )
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
@@ -376,6 +398,8 @@ class _FileLinter:
                     self._lint_host_transfer(node)
                 if fence_plane:
                     self._lint_fenced_journal(node)
+                if slot_plane:
+                    self._lint_shadow_slot_call(node)
                 self._lint_metric_name(node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._lint_socket_under_peer_lock(node)
@@ -384,6 +408,11 @@ class _FileLinter:
                     self._lint_unbounded_queue(node)
                 if fence_plane:
                     self._lint_table_mutation(node)
+                if slot_plane:
+                    self._lint_shadow_slot_store(node)
+            elif isinstance(node, ast.AugAssign):
+                if slot_plane:
+                    self._lint_shadow_slot_store(node)
         if self.path.replace(os.sep, "/").endswith("telemetry/inspect.py"):
             self._lint_inspect_readonly()
         if lint_asserts:
@@ -641,6 +670,85 @@ class _FileLinter:
                     "shard-table store bypasses the fenced transition "
                     "helpers in cluster/sharding.py",
                 )
+
+    @staticmethod
+    def _receiver_name(expr: ast.AST) -> str:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return ""
+
+    def _lint_shadow_slot_store(self, node: ast.AST) -> None:
+        """UL014 (store half): authoritative shadow slots — flags,
+        supervisor pointers, receive balances, edge maps — are written
+        only by the fold plane (_SHADOW_FOLD_MODULES), which the
+        distributed collector routes every fact through so it lands at
+        the owning partition.  A direct store anywhere else mutates
+        state this node may not own — exactly the class the per-sweep
+        fold-locality audit catches at runtime."""
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        else:
+            targets = [node.target]
+        for target in targets:
+            if isinstance(target, ast.Attribute):
+                recv = self._receiver_name(target.value)
+                if recv == "self":
+                    continue
+                hit = target.attr in _SHADOW_SLOT_ATTRS or (
+                    target.attr == "recv_count" and "shadow" in recv.lower()
+                )
+                if hit:
+                    self.add(
+                        node.lineno,
+                        "UL014",
+                        f"shadow slot .{target.attr} written outside the "
+                        "fold plane; route the fact through the "
+                        "dmark/delta plane (engines/crgc/delta.py fold_* "
+                        "-> owner merge)",
+                    )
+            elif isinstance(target, ast.Subscript):
+                value = target.value
+                if (
+                    isinstance(value, ast.Attribute)
+                    and value.attr == "outgoing"
+                ):
+                    self.add(
+                        node.lineno,
+                        "UL014",
+                        "shadow edge map .outgoing[...] written outside "
+                        "the fold plane; route through the dmark/delta "
+                        "plane",
+                    )
+
+    def _lint_shadow_slot_call(self, call: ast.Call) -> None:
+        """UL014 (call half): mutating calls on a shadow's edge map and
+        the ``_update_outgoing`` helper are fold-plane-only for the
+        same ownership reason."""
+        qual, name = _call_name(call)
+        if name == "_update_outgoing":
+            self.add(
+                call.lineno,
+                "UL014",
+                "_update_outgoing(...) outside the fold plane mutates a "
+                "shadow edge map directly; route through the dmark/delta "
+                "plane",
+            )
+            return
+        fn = call.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("clear", "pop", "setdefault", "update")
+            and isinstance(fn.value, ast.Attribute)
+            and fn.value.attr == "outgoing"
+        ):
+            self.add(
+                call.lineno,
+                "UL014",
+                f"shadow edge map .outgoing.{fn.attr}(...) outside the "
+                "fold plane; route through the dmark/delta plane",
+            )
 
     def _lint_unbounded_queue(self, node: ast.AST) -> None:
         """UL012: queue-shaped attributes in runtime//cluster/ must be

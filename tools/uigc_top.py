@@ -60,6 +60,7 @@ KEY_SERIES: Tuple[Tuple[str, str, str], ...] = (
     ("uigc_send_matrix_pairs", "send pairs", "last"),
     ("uigc_leak_suspects_total", "leak suspects", "last"),
     ("uigc_fence_rejected_total", "fence rejects/s", "rate"),
+    ("uigc_dist_marks_exchanged_total", "dist marks/s", "rate"),
 )
 
 #: header gauges pulled from /metrics.json: (metric, short label)
@@ -353,28 +354,49 @@ def render_dashboard(
                 f"  {peer:<28} phi {fmt_si(phi):>7}  "
                 f"queue {fmt_si(health.get('queue')):>7}  [{state}]"
             )
-    # Partition-tolerance counters (cluster/membership.py): totals per
-    # metric, summed over labelsets — nonzero means the split-brain
-    # plane acted (or is refusing stale work) on this node.
-    sbr_cells = []
-    for metric, label in (
-        ("uigc_cluster_partitions_total", "partitions"),
-        ("uigc_sbr_downed_total", "sbr-downed"),
-        ("uigc_fence_rejected_total", "fence-rejected"),
-        ("uigc_membership_disagreements_total", "view-conflicts"),
-    ):
-        total = 0.0
-        seen_any = False
-        for s in _find_series({"series": series_list}, metric):
-            pts = series_points(s, "last")
-            if pts:
-                seen_any = True
-                total += pts[-1][1]
-        if seen_any and total > 0:
-            sbr_cells.append(f"{label} {fmt_si(total)}")
-    if sbr_cells:
-        lines.append("")
-        lines.append("partition plane: " + "  ".join(sbr_cells))
+    def metric_row(title, pairs, show_at_zero=()):
+        """One 'plane' row: each metric's last sample summed over its
+        labelsets.  Metrics in ``show_at_zero`` render even at 0 (an
+        idle gauge is informative; an untouched counter is noise)."""
+        cells = []
+        for metric, label in pairs:
+            total = 0.0
+            seen_any = False
+            for s in _find_series({"series": series_list}, metric):
+                pts = series_points(s, "last")
+                if pts:
+                    seen_any = True
+                    total += pts[-1][1]
+            if seen_any and (total > 0 or metric in show_at_zero):
+                cells.append(f"{label} {fmt_si(total)}")
+        if cells:
+            lines.append("")
+            lines.append(title + ": " + "  ".join(cells))
+
+    # Partition-tolerance counters (cluster/membership.py): nonzero
+    # means the split-brain plane acted (or is refusing stale work).
+    metric_row(
+        "partition plane",
+        (
+            ("uigc_cluster_partitions_total", "partitions"),
+            ("uigc_sbr_downed_total", "sbr-downed"),
+            ("uigc_fence_rejected_total", "fence-rejected"),
+            ("uigc_membership_disagreements_total", "view-conflicts"),
+        ),
+    )
+    # Distributed-collector plane (engines/crgc/distributed.py): the
+    # cross-node trace protocol's surface — boundary edges shown even
+    # at zero so an idle partitioned node is visible.
+    metric_row(
+        "distributed collector",
+        (
+            ("uigc_dist_boundary_edges", "boundary-edges"),
+            ("uigc_dist_marks_exchanged_total", "marks"),
+            ("uigc_dist_wave_rounds_total", "rounds"),
+            ("uigc_dist_refolds_total", "refolds"),
+        ),
+        show_at_zero=("uigc_dist_boundary_edges",),
+    )
     lines.append("")
     lines.extend(render_device_panel(device))
     firing = (alerts or {}).get("firing", [])

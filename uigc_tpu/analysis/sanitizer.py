@@ -220,6 +220,18 @@ class _MirrorGraph:
         self._san._fold_undo(log)
         self._real.merge_undo_log(log)
 
+    # -- distributed-mode folds -------------------------------------- #
+
+    def reset_partition(self, partitions: Any) -> int:
+        # The absorb path (engines/crgc/distributed.py): the gained
+        # slices are cleared and re-folded from retained journals.  The
+        # oracle must reset the SAME slice, or the journal re-fold
+        # (which arrives through merge_delta above) double-counts every
+        # balance and edge for the gained partitions.
+        real = object.__getattribute__(self, "_real")
+        self._san._reset_partition(partitions, real.partition_map)
+        return real.reset_partition(partitions)
+
     # -- verdicts ---------------------------------------------------- #
 
     def trace(self, should_kill: bool) -> int:
@@ -266,6 +278,20 @@ class Sanitizer:
         #: cascade of stop decisions costs one traversal, not one each.
         self._reach_cache: Optional[Set[Any]] = None
         self.checks = 0
+        # Distributed-collector mode (engines/crgc/distributed.py): the
+        # per-node oracle holds only the owned slice (facts are routed,
+        # not broadcast), so single-node verdict checks cannot judge a
+        # cross-node cycle — the sweep instead records its verdicts
+        # here, and :func:`cross_check_distributed` merges every node's
+        # oracle into one global graph to judge them.
+        #: cumulative (address, uid) keys this node's distributed
+        #: sweeps declared garbage
+        self.dist_garbage_keys: Set[Any] = set()
+        #: the last sweep's live (marked, owned) key set
+        self.dist_live_keys: Set[Any] = set()
+        #: wave id of the last recorded distributed sweep
+        self.dist_last_wave = 0
+        self.dist_sweeps = 0
 
     # -- attachment --------------------------------------------------- #
 
@@ -570,6 +596,79 @@ class Sanitizer:
                 oracle_addresses=self.oracle.addresses_in_graph(),
             )
 
+    # -- distributed mode (collector thread) ---------------------------- #
+
+    def _reset_partition(self, partitions: Any, pmap: Any) -> None:
+        """Mirror of PartitionedShadowGraph.reset_partition over the
+        oracle: clear the authoritative state of every oracle shadow in
+        the gained partitions (objects kept — other shadows' edges
+        reference them by identity) so the journal re-fold rebuilds the
+        oracle and the real slice from the same blank."""
+        if pmap is None:
+            return
+        from ..engines.crgc.shadow import clear_authoritative_state
+        from ..parallel.partition import cell_key
+
+        with self._lock:
+            self._reach_cache = None
+            for shadow in self.oracle.from_set:
+                key = cell_key(shadow.self_cell)
+                if pmap.partition_of(key) in partitions:
+                    clear_authoritative_state(shadow)
+
+    def note_dist_sweep(self, wave: int, garbage_keys: Any, live_keys: Any) -> None:
+        """One distributed sweep's verdicts for this node's owned slice.
+        Recorded, not judged: a cross-node cycle's liveness is not
+        decidable from one node's oracle — :func:`cross_check_distributed`
+        merges every node's oracle and judges the accumulated verdicts
+        against the global graph."""
+        with self._lock:
+            self.dist_garbage_keys.update(garbage_keys)
+            self.dist_live_keys = set(live_keys)
+            self.dist_last_wave = wave
+            self.dist_sweeps += 1
+        events.recorder.commit(
+            events.ANALYSIS_CHECK,
+            node=self.system.address,
+            n_garbage=len(garbage_keys),
+            oracle_garbage=-1,  # judged globally, not per node
+        )
+
+    def oracle_slice(self, pmap: Any) -> Dict[Any, Dict[str, Any]]:
+        """This node's owned slice of the oracle as plain data keyed by
+        (address, uid) — the unit :func:`merged_oracle` aggregates.
+        Only keys the given partition map assigns to this node are
+        exported: mirror shadows (non-owned edge endpoints) carry no
+        authoritative state here and undo folds may have adjusted their
+        balances redundantly, so the owner's record is the one that
+        counts."""
+        from ..parallel.partition import cell_key
+
+        out: Dict[Any, Dict[str, Any]] = {}
+        with self._lock:
+            for shadow in self.oracle.from_set:
+                key = cell_key(shadow.self_cell)
+                if pmap is not None and not pmap.owns(key):
+                    continue
+                out[key] = {
+                    "interned": shadow.interned,
+                    "is_root": shadow.is_root,
+                    "is_busy": shadow.is_busy,
+                    "is_halted": shadow.is_halted,
+                    "recv": shadow.recv_count,
+                    "supervisor": (
+                        cell_key(shadow.supervisor.self_cell)
+                        if shadow.supervisor is not None
+                        else None
+                    ),
+                    "outgoing": {
+                        cell_key(t.self_cell): c
+                        for t, c in shadow.outgoing.items()
+                        if c != 0
+                    },
+                }
+        return out
+
     # -- reachability / quiescence ------------------------------------- #
 
     def _oracle_reachable(self) -> Set[Any]:
@@ -661,3 +760,107 @@ class Sanitizer:
         if raise_mode and found:
             raise found[0]
         return found
+
+
+# ------------------------------------------------------------------- #
+# Distributed mode: merge per-node oracles, judge every sweep verdict
+# against the global graph (engines/crgc/distributed.py).
+# ------------------------------------------------------------------- #
+
+
+class MergedOracle:
+    """The union of every node's owned oracle slice — the pointer-exact
+    global shadow graph no single node of the partitioned collector is
+    allowed to hold.  State is owner-authoritative: each actor's record
+    comes from the oracle of the node whose partition map owns it, so a
+    mirror's redundant undo-fold adjustments can never double-count.
+
+    ``live`` / ``garbage`` partition the key space by the same
+    pseudo-root closure the single-host trace runs (halted actors can be
+    marked but never propagate), which is the fixpoint the distributed
+    wave protocol must iterate to."""
+
+    def __init__(self, state: Dict[Any, Dict[str, Any]], nodes: List[str]):
+        self.state = state
+        self.nodes = nodes
+        self.live: Set[Any] = set()
+        self._close()
+        self.garbage: Set[Any] = set(state) - self.live
+
+    def _close(self) -> None:
+        state = self.state
+        frontier = []
+        for key, rec in state.items():
+            pseudo_root = (
+                rec["is_root"]
+                or rec["is_busy"]
+                or rec["recv"] != 0
+                or not rec["interned"]
+            ) and not rec["is_halted"]
+            if pseudo_root:
+                self.live.add(key)
+                frontier.append(key)
+        while frontier:
+            key = frontier.pop()
+            rec = state.get(key)
+            if rec is None or rec["is_halted"]:
+                continue
+            for target, count in rec["outgoing"].items():
+                if count > 0 and target not in self.live:
+                    self.live.add(target)
+                    frontier.append(target)
+            sup = rec["supervisor"]
+            if sup is not None and sup not in self.live:
+                self.live.add(sup)
+                frontier.append(sup)
+
+
+def merged_oracle(systems: Any) -> MergedOracle:
+    """Merge the live systems' sanitizer oracles into one global graph.
+    Every system must be sanitizer-attached and running the distributed
+    collector (so each oracle holds exactly its owned slice)."""
+    state: Dict[Any, Dict[str, Any]] = {}
+    nodes: List[str] = []
+    for system in systems:
+        san = getattr(system, "sanitizer", None)
+        if san is None or san.oracle is None:
+            continue
+        pmap = getattr(system.engine.bookkeeper, "pmap", None)
+        nodes.append(system.address)
+        state.update(san.oracle_slice(pmap))
+    return MergedOracle(state, nodes)
+
+
+def cross_check_distributed(systems: Any) -> List[SanitizerViolation]:
+    """The distributed verdict check: every key any node's sweeps
+    declared garbage must be unreachable in the merged global oracle.
+    Garbage is monotone in CRGC, so a correct past verdict stays
+    unreachable; a premature collection stays visible because the live
+    holder's positive edge to the victim is still in its owner's oracle.
+    Each violation is recorded on the judged node's own sanitizer (so
+    per-node "sanitizer clean" assertions catch it) and the new
+    violations are returned."""
+    merged = merged_oracle(systems)
+    found: List[SanitizerViolation] = []
+    for system in systems:
+        san = getattr(system, "sanitizer", None)
+        if san is None:
+            continue
+        with san._lock:
+            swept = set(san.dist_garbage_keys)
+        bad = swept & merged.live
+        if bad:
+            before = len(san.violations)
+            raise_mode, san.raise_on_violation = san.raise_on_violation, False
+            san.record(
+                "verdict.mismatch",
+                "distributed sweep collected actors the merged oracle "
+                "proves reachable",
+                node=system.address,
+                keys=sorted(f"{a}#{u}" for a, u in bad),
+                merged_nodes=merged.nodes,
+            )
+            san.raise_on_violation = raise_mode
+            with san._lock:
+                found.extend(san.violations[before:])
+    return found

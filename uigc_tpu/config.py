@@ -75,6 +75,19 @@ DEFAULTS: Dict[str, Any] = {
     # decremental and mesh-decremental backends support it; others
     # ignore the flag.
     "uigc.crgc.pipelined": False,
+    # Distributed (partitioned) collection across cluster nodes
+    # (engines/crgc/distributed.py): each node owns only the
+    # shadow-graph slice for the partitions the rendezvous map assigns
+    # it, mutator entries route to the owner as targeted deltas, trace
+    # waves exchange boundary marks ("dmark" frames) and decide global
+    # convergence with Safra-style rounds over a reduction tree — no
+    # node ever folds the full graph.  Requires num-nodes > 1; off,
+    # multi-node collection keeps the replicated (full-copy) mode.
+    "uigc.crgc.distributed": False,
+    # Partitions in the cross-node shadow-graph key space; 0 aligns
+    # with uigc.cluster.num-shards so entity placement and shadow
+    # partitioning share one granularity (and one rendezvous family).
+    "uigc.crgc.dist-partitions": 0,
     # Packed mutator->collector entry plane (SURVEY §7): flushes write
     # int64 rows into per-thread ring buffers instead of object Entries,
     # so the Bookkeeper's fold is pure array work.  Automatically falls

@@ -245,9 +245,26 @@ class Bookkeeper(RawBehavior):
             # arrive).
             self.downed_gcs.discard(address)
             self.undone_gcs.discard(address)
-            self.undo_logs[address] = UndoLog(address)
+            # Rejoin opens a new incarnation era for the address: the
+            # ingress gateways key their windows by (peer, fence) from
+            # here on, and the fresh log's fence floor drops pre-death
+            # stragglers still in flight (gateways.py fence discipline).
+            fence = self.engine.bump_link_fence(address)
+            log = UndoLog(
+                address, fence=fence, own_address=self.engine.system.address,
+                expected_nonce=self._peer_nonce(address),
+            )
+            prior = self.undo_logs.get(address)
+            if prior is not None:
+                log.seed_floors(prior)
+            self.undo_logs[address] = log
         elif address not in self.undo_logs:
-            self.undo_logs[address] = UndoLog(address)
+            self.undo_logs[address] = UndoLog(
+                address,
+                fence=self.engine.link_fence(address),
+                own_address=self.engine.system.address,
+                expected_nonce=self._peer_nonce(address),
+            )
         # Establish both link directions eagerly (the Artery-handshake
         # analogue) so crash-time finalization always has an ingress,
         # even for pairs that never exchanged app messages.
@@ -255,6 +272,12 @@ class Bookkeeper(RawBehavior):
         fabric.link(peer_system, self.engine.system)
         if not self.started and len(self.remote_gcs) + 1 == self.engine.num_nodes:
             self.start()
+
+    def _peer_nonce(self, address: str) -> int:
+        """The process-incarnation nonce of ``address`` as the fabric
+        currently knows it (0 = none): captured into each UndoLog at
+        creation so the log is pinned to the incarnation it covers."""
+        return self.engine.system.fabric.peer_nonce(address) or 0
 
     def remove_member(self, address: str) -> None:
         """(reference: LocalGC.scala:228-243)"""
@@ -302,8 +325,26 @@ class Bookkeeper(RawBehavior):
         addr = entry.egress_address
         log = self.undo_logs.get(addr)
         if log is None:
-            log = UndoLog(addr)
+            log = UndoLog(
+                addr,
+                fence=self.engine.link_fence(addr),
+                own_address=self.engine.system.address,
+                expected_nonce=self._peer_nonce(addr),
+            )
             self.undo_logs[addr] = log
+        if log.stale_fence(entry):
+            # A pre-death straggler of a rejoined incarnation: merging
+            # it would mix the dead era's windows into the live stream's
+            # accounting (the latent (peer, fence) bug).
+            events.recorder.commit(
+                events.STALE_WINDOW,
+                peer=addr,
+                ingress=entry.ingress_address,
+                window=entry.id,
+                fence=entry.fence,
+                log_fence=log.fence,
+            )
+            return
         log.merge_ingress_entry(entry)
         if entry.is_final:
             self._maybe_fold_undo_log(addr)

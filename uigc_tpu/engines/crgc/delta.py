@@ -152,6 +152,92 @@ class DeltaGraph:
         else:
             outgoing[target] = count
 
+    # ------------------------------------------------------------- #
+    # Routed folds (engines/crgc/distributed.py): one entry's effects
+    # split per owning partition.  Each method applies exactly the
+    # slice of merge_entry that touches ONE actor's authoritative
+    # state, so the distributed router can direct every effect to the
+    # delta bound for that actor's owner — and nothing else.
+    # ------------------------------------------------------------- #
+
+    def touch(self, cell: "ActorCell") -> None:
+        """Bare mention: ensure the cell has a (default, non-interned)
+        shadow in this delta so the owner's graph learns the actor
+        exists — the partitioned twin of merge_entry's on-demand
+        ``get_shadow`` for edge endpoints (a never-interned shadow is a
+        pseudoroot, which is what keeps the single-host and partitioned
+        verdicts identical for actors that only ever appear as created
+        targets)."""
+        self._encode(cell)
+
+    def fold_self(
+        self, cell: "ActorCell", recv_count: int, is_busy: bool, is_root: bool
+    ) -> None:
+        """The entry's self-actor slice (flags + receive balance)."""
+        shadow = self.shadows[self._encode(cell)]
+        shadow.interned = True
+        shadow.recv_count += recv_count
+        shadow.is_busy = is_busy
+        shadow.is_root = is_root
+
+    def fold_created(self, owner: "ActorCell", target: "ActorCell") -> None:
+        """A created ref: the owner gains an outgoing edge (edges live
+        at the SOURCE actor's owner)."""
+        target_id = self._encode(target)
+        owner_shadow = self.shadows[self._encode(owner)]
+        self._update_outgoing(owner_shadow.outgoing, target_id, 1)
+
+    def fold_spawned(self, child: "ActorCell", supervisor: "ActorCell") -> None:
+        """A spawn: the child's supervisor pointer (lives at the
+        CHILD's owner)."""
+        sup_id = self._encode(supervisor)
+        self.shadows[self._encode(child)].supervisor = sup_id
+
+    def fold_sends(self, target: "ActorCell", count: int) -> None:
+        """Sends count against the target's receive balance (lives at
+        the TARGET's owner)."""
+        self.shadows[self._encode(target)].recv_count -= count
+
+    def fold_deactivate(self, owner: "ActorCell", target: "ActorCell") -> None:
+        """A released ref: the owner loses an outgoing edge (lives at
+        the SOURCE actor's owner)."""
+        target_id = self._encode(target)
+        owner_shadow = self.shadows[self._encode(owner)]
+        self._update_outgoing(owner_shadow.outgoing, target_id, -1)
+
+    def compact(self, keep: Callable[["ActorCell", DeltaShadow], bool]) -> "DeltaGraph":
+        """A new graph holding only the shadows ``keep`` accepts.  A
+        dropped cell that a kept shadow still references (positive or
+        negative edge, or supervisor pointer) survives as a BARE entry
+        — the ``touch`` semantics — so the kept facts re-fold into an
+        identical slice; a dropped cell nothing kept references
+        vanishes entirely, unpinning it from the compression table.
+        The distributed collector's retained-journal compaction path:
+        pruning a fact can only make a re-folded actor look MORE
+        alive, never less (leak-safe by construction)."""
+        out = DeltaGraph(self.address, self.context)
+        decoder = self.decoder()
+        for i, sh in enumerate(self.shadows):
+            cell = decoder[i]
+            if cell is None or not keep(cell, sh):
+                continue
+            ns = out.shadows[out._encode(cell)]
+            ns.interned = sh.interned
+            ns.recv_count = sh.recv_count
+            ns.is_busy = sh.is_busy
+            ns.is_root = sh.is_root
+            if sh.supervisor >= 0:
+                sup_cell = decoder[sh.supervisor]
+                if sup_cell is not None:
+                    ns.supervisor = out._encode(sup_cell)
+            for tid, cnt in sh.outgoing.items():
+                if cnt == 0:
+                    continue
+                target_cell = decoder[tid]
+                if target_cell is not None:
+                    ns.outgoing[out._encode(target_cell)] = cnt
+        return out
+
     def decoder(self) -> List["ActorCell"]:
         """(reference: DeltaGraph.java:162-169)"""
         refs: List[Optional["ActorCell"]] = [None] * self.size

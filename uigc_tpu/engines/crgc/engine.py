@@ -10,7 +10,7 @@ requires no message ordering and tolerates drops and downed nodes.
 from __future__ import annotations
 
 from collections import deque
-from typing import TYPE_CHECKING, Any, Callable, Iterable, Optional
+from typing import TYPE_CHECKING, Any, Callable, Dict, Iterable, Optional
 
 from ...interfaces import GCMessage, Refob, SpawnInfo
 from ...runtime.signals import _PostStop
@@ -57,6 +57,19 @@ class CRGC(Engine):
         )
         self.shadow_graph_impl = config.get_string("uigc.crgc.shadow-graph")
         self.pipelined = config.get_bool("uigc.crgc.pipelined")
+        # Distributed (partitioned) collection: each node owns only its
+        # shadow-graph slice and cross-node cycles resolve via the
+        # dmark wave protocol (engines/crgc/distributed.py).  Only
+        # meaningful multi-node; single-node configs fall back to the
+        # local collector so one config can serve both shapes.
+        self.distributed = (
+            config.get_bool("uigc.crgc.distributed") and self.num_nodes > 1
+        )
+        #: per-address incarnation era as THIS node counts it: bumped
+        #: when a downed address rejoins, read by the ingress gateways
+        #: so a rejoined incarnation's windows key as (peer, fence) and
+        #: never merge with its pre-death stream (gateways.py)
+        self._link_fences: Dict[str, int] = {}
 
         # Mutator->collector channel + entry free list.  CPython deque
         # append/popleft are atomic, giving the lock-free MPSC hand-off the
@@ -88,9 +101,22 @@ class CRGC(Engine):
     # Factory hooks so the multi-node engine can substitute richer parts.
 
     def make_bookkeeper(self) -> Bookkeeper:
+        if self.distributed:
+            from .distributed import DistributedBookkeeper
+
+            return DistributedBookkeeper(self)
         return Bookkeeper(self)
 
     def make_shadow_graph(self) -> Any:
+        if self.distributed:
+            # The partitioned plane: authoritative state only for the
+            # owned slice, mirrors for boundary endpoints.  The local
+            # fixpoint runs the pointer plane; the device backends keep
+            # sharding *within* the node (mesh) and plug in behind the
+            # same dmark interface as a follow-on.
+            from .distributed import PartitionedShadowGraph
+
+            return PartitionedShadowGraph(self.crgc_context, self.system.address)
         if self.shadow_graph_impl == "oracle":
             from .shadow import ShadowGraph
 
@@ -309,6 +335,15 @@ class CRGC(Engine):
     # ----------------------------------------------------------------- #
     # Remoting interception (reference: CRGC.scala:223-241)
     # ----------------------------------------------------------------- #
+
+    def link_fence(self, address: "str | None") -> int:
+        """The incarnation era of ``address`` (0 until it ever rejoins)."""
+        return self._link_fences.get(address, 0)
+
+    def bump_link_fence(self, address: str) -> int:
+        fence = self._link_fences.get(address, 0) + 1
+        self._link_fences[address] = fence
+        return fence
 
     def spawn_egress(self, link: Any) -> Any:
         from .gateways import Egress

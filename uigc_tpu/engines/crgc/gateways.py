@@ -43,9 +43,27 @@ class IngressEntryField:
 
 class IngressEntry:
     """Per-link tally of admitted messages and refs
-    (reference: IngressEntry.java:12-100)."""
+    (reference: IngressEntry.java:12-100).
 
-    __slots__ = ("id", "admitted", "egress_address", "ingress_address", "is_final")
+    ``fence`` is the *incarnation era* of the egress peer as counted by
+    the tallying node (bumped once per observed death of that address,
+    engine ``bump_link_fence``): windows are keyed by (peer, fence), so
+    a rejoined incarnation's window ids — which restart from zero —
+    can never merge with stragglers of its pre-death stream.
+
+    ``nonce`` is the egress peer's process-incarnation identity (the
+    NodeFabric hello nonce) as known to the tallying node when the
+    window opened — unlike the fence it is the SAME value at every
+    observer, so an undo log can refuse another node's stragglers about
+    a previous incarnation outright instead of inferring staleness from
+    that node's own (incomparable) era counter.  0 = unknown (an
+    in-process fabric, or a frame from a peer that predates the
+    field)."""
+
+    __slots__ = (
+        "id", "admitted", "egress_address", "ingress_address", "is_final",
+        "fence", "nonce",
+    )
 
     def __init__(self) -> None:
         self.id = 0
@@ -53,6 +71,8 @@ class IngressEntry:
         self.egress_address: Optional[str] = None
         self.ingress_address: Optional[str] = None
         self.is_final = False
+        self.fence = 0
+        self.nonce = 0
 
     def on_message(self, recipient: "ActorCell", refs: Iterable[Any]) -> None:
         """(reference: IngressEntry.java:91-100)"""
@@ -69,6 +89,8 @@ class IngressEntry:
         return (
             isinstance(other, IngressEntry)
             and self.id == other.id
+            and self.fence == other.fence
+            and self.nonce == other.nonce
             and self.is_final == other.is_final
             and self.egress_address == other.egress_address
             and self.ingress_address == other.ingress_address
@@ -100,6 +122,11 @@ class IngressEntry:
                 parts.append(struct.pack(">h", len(tref)))
                 parts.append(tref)
                 parts.append(struct.pack(">i", count))
+        # Fence era + incarnation nonce as trailing fields: decoders
+        # that predate them stop at the admitted map (tolerant both
+        # directions; a fence-only peer reads the fence and ignores
+        # the nonce bytes).
+        parts.append(struct.pack(">iQ", self.fence, self.nonce))
         data = b"".join(parts)
         if events.recorder.enabled:
             events.recorder.commit(events.INGRESS_ENTRY_SERIALIZATION, size=len(data))
@@ -143,6 +170,10 @@ class IngressEntry:
                 offset += 4
                 field.created_refs[target] = count
             entry.admitted[cell] = field
+        if offset + 4 <= len(buf):
+            (entry.fence,) = struct.unpack_from(">i", buf, offset)
+        if offset + 12 <= len(buf):
+            (entry.nonce,) = struct.unpack_from(">Q", buf, offset + 4)
         return entry
 
 
@@ -177,7 +208,9 @@ class Egress(Gateway):
     reference's egress also tallies into its own entry, but that entry's
     content is discarded at the ingress — Gateways.scala:168-171 uses it
     purely as a window-boundary marker — so the duplicate per-message
-    bookkeeping is skipped here.)"""
+    bookkeeping is skipped here.)  The fence era a window belongs to is
+    stamped by the *ingress* (the tallying side counts the egress
+    peer's deaths); the egress needs none."""
 
     def __init__(self, link: "Link"):
         super().__init__(link.src.address, link.dst.address)
@@ -203,12 +236,32 @@ class Ingress:
         self.egress_address = link.src.address
         self.ingress_address = link.dst.address
         self.engine = engine
-        self.entries: Dict[int, IngressEntry] = {}
-        self._max_window = -1
+        #: (fence, window_id) -> tally: a rejoined incarnation restarts
+        #: its window numbering from zero, and only the fence era keeps
+        #: its stream apart from pre-death stragglers of the same ids
+        self.entries: Dict[tuple, IngressEntry] = {}
+        #: highest window id seen per fence era (the final entry that
+        #: joins the crash quorum must outnumber every era window)
+        self._max_window: Dict[int, int] = {}
 
-    def _make_entry(self, window_id: int) -> IngressEntry:
+    def _fence(self) -> int:
+        """The egress peer's incarnation era, as this node counts it."""
+        return self.engine.link_fence(self.egress_address)
+
+    def _nonce(self) -> int:
+        """The egress peer's process-incarnation nonce (0 when the
+        fabric has none — in-process, or pre-hello)."""
+        system = getattr(self.engine, "system", None)
+        fabric = getattr(system, "fabric", None)
+        if fabric is None:
+            return 0
+        return fabric.peer_nonce(self.egress_address) or 0
+
+    def _make_entry(self, window_id: int, fence: int) -> IngressEntry:
         entry = IngressEntry()
         entry.id = window_id
+        entry.fence = fence
+        entry.nonce = self._nonce()
         entry.egress_address = self.egress_address
         entry.ingress_address = self.ingress_address
         return entry
@@ -216,10 +269,12 @@ class Ingress:
     def on_message(self, recipient: "ActorCell", msg: Any) -> None:
         if isinstance(msg, AppMsg):
             wid = msg.window_id
-            self._max_window = max(self._max_window, wid)
-            entry = self.entries.get(wid)
+            fence = self._fence()
+            if wid > self._max_window.get(fence, -1):
+                self._max_window[fence] = wid
+            entry = self.entries.get((fence, wid))
             if entry is None:
-                entry = self.entries[wid] = self._make_entry(wid)
+                entry = self.entries[(fence, wid)] = self._make_entry(wid, fence)
             entry.on_message(recipient, msg.refs)
 
     def on_messages(self, recipient: "ActorCell", msgs: list) -> None:
@@ -228,15 +283,18 @@ class Ingress:
         per message — same per-message semantics, the loop just lives
         inside the gateway."""
         entries = self.entries
+        fence = self._fence()
+        max_w = self._max_window.get(fence, -1)
         for msg in msgs:
             if isinstance(msg, AppMsg):
                 wid = msg.window_id
-                if wid > self._max_window:
-                    self._max_window = wid
-                entry = entries.get(wid)
+                if wid > max_w:
+                    max_w = wid
+                entry = entries.get((fence, wid))
                 if entry is None:
-                    entry = entries[wid] = self._make_entry(wid)
+                    entry = entries[(fence, wid)] = self._make_entry(wid, fence)
                 entry.on_message(recipient, msg.refs)
+        self._max_window[fence] = max_w
 
     def _send(self, entry: IngressEntry) -> None:
         from .collector import LocalIngressEntry
@@ -246,31 +304,36 @@ class Ingress:
     def finalize_window(self, window_id: int, is_final: bool = False) -> None:
         """Close the window the egress marker names (empty entries are
         emitted too — the collector's undo log needs the window sequence
-        even when no traffic was admitted)."""
-        self._max_window = max(self._max_window, window_id)
-        entry = self.entries.pop(window_id, None)
+        even when no traffic was admitted).  Markers ride in-stream, so
+        the era they close is the link's current one."""
+        fence = self._fence()
+        if window_id > self._max_window.get(fence, -1):
+            self._max_window[fence] = window_id
+        entry = self.entries.pop((fence, window_id), None)
         if entry is None:
-            entry = self._make_entry(window_id)
+            entry = self._make_entry(window_id, fence)
         if is_final:
             entry.is_final = True
         self._send(entry)
 
     def finalize_all(self, is_final: bool = False) -> None:
-        """Link death: flush every open window in order, then emit the
-        final (possibly empty) entry that joins the crash quorum
-        (reference: Gateways.scala:129, LocalGC.scala:251-266)."""
-        for wid in sorted(self.entries):
-            entry = self.entries.pop(wid)
+        """Link death: flush every open window in order (older eras
+        first — their markers are never coming), then emit the final
+        (possibly empty) entry that joins the crash quorum under the
+        dying era (reference: Gateways.scala:129, LocalGC.scala:251-266)."""
+        fence = self._fence()
+        for key in sorted(self.entries):
+            entry = self.entries.pop(key)
             self._send(entry)
-        final_entry = self._make_entry(self._max_window + 1)
+        final_entry = self._make_entry(self._max_window.get(fence, -1) + 1, fence)
         final_entry.is_final = is_final
         self._send(final_entry)
 
     def open_windows(self) -> list:
-        """Window ids still awaiting their boundary marker (chaos-bench
-        diagnostics; a healthy link converges to empty between
-        finalizations — windows that never close are admitted counts the
-        collector will only see at link death)."""
+        """(fence, window_id) pairs still awaiting their boundary marker
+        (chaos-bench diagnostics; a healthy link converges to empty
+        between finalizations — windows that never close are admitted
+        counts the collector will only see at link death)."""
         return sorted(self.entries)
 
     # Compatibility shim for the lockstep call shape (single window).

@@ -41,11 +41,15 @@ class Shadow:
         "is_local",
         "is_busy",
         "is_halted",
+        "partition",
     )
 
     def __init__(self) -> None:
         self.self_cell: Optional["ActorCell"] = None
         self.location: Optional[str] = None
+        #: cross-node partition id memo (parallel/partition.py) — pure
+        #: in the cell's (address, uid), so computed once per shadow
+        self.partition: Optional[int] = None
         #: net created-minus-deactivated refs toward each target; may be
         #: negative (reference: Shadow.java:14-19)
         self.outgoing: Dict["Shadow", int] = {}
@@ -75,6 +79,35 @@ def _update_outgoing(outgoing: Dict[Shadow, int], target: Shadow, delta: int) ->
         outgoing.pop(target, None)
     else:
         outgoing[target] = count
+
+
+def clear_authoritative_state(shadow: Shadow) -> None:
+    """Reset every authoritative slot of one shadow in place (the
+    object is kept — other shadows' edges reference it by identity).
+    Shared by the distributed absorb path and the sanitizer's oracle
+    mirror of it, so the two can never drift on which fields count as
+    authoritative."""
+    shadow.outgoing.clear()
+    shadow.supervisor = None
+    shadow.recv_count = 0
+    shadow.interned = False
+    shadow.is_root = False
+    shadow.is_busy = False
+    shadow.is_halted = False
+
+
+def dispatch_kills(cells) -> None:
+    """Bulk teardown of a sweep's kill set: one dispatcher submission
+    per dispatcher for the whole set, not one per actor (runtime/cell.py
+    tell_bulk).  Shared by the single-host trace below and the
+    distributed sweep (engines/crgc/distributed.py) — remote cells in
+    the set are ProxyCells whose tell routes the StopMsg over the
+    fabric."""
+    if not cells:
+        return
+    from ...runtime.cell import tell_bulk
+
+    tell_bulk((cell, StopMsg) for cell in cells)
 
 
 class ShadowGraph:
@@ -305,13 +338,7 @@ class ShadowGraph:
                             kills.append(shadow.self_cell)
                     else:
                         num_live += 1
-                if kills:
-                    # Bulk teardown: one dispatcher submission per
-                    # dispatcher for the whole kill set, not one per
-                    # actor (runtime/cell.py tell_bulk).
-                    from ...runtime.cell import tell_bulk
-
-                    tell_bulk((cell, StopMsg) for cell in kills)
+                dispatch_kills(kills)
 
                 self.from_set = to_set
                 self.marked = not marked

@@ -179,6 +179,13 @@ class Fabric:
         with self._lock:
             return [a for a in self.systems if a not in self.crashed]
 
+    def peer_nonce(self, address: str) -> Optional[int]:
+        """In-process systems have no process-incarnation identity
+        (NodeFabric overrides this with the hello nonce); None disables
+        the undo log's nonce discipline and leaves the fence era as the
+        only incarnation separator."""
+        return None
+
     # ------------------------------------------------------------- #
     # Links and delivery
     # ------------------------------------------------------------- #

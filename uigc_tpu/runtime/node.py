@@ -798,6 +798,15 @@ class NodeFabric:
         st = self._peers.get(address)
         return st is not None and st.shm_tx_on
 
+    def peer_nonce(self, address: str) -> Optional[int]:
+        """The process-incarnation nonce ``address`` presented in its
+        hello, or None before any hello.  Ingress windows stamp it so
+        crash-quorum accounting can tell two incarnations of the same
+        address apart with an identity no per-observer counter can
+        alias (engines/crgc/undo.py)."""
+        st = self._peers.get(address)
+        return st.nonce if st is not None else None
+
     def _peer_state(self, address: str) -> _PeerState:
         # Lock-free fast path: dict reads are atomic under the GIL and
         # peer states are never removed, only created — the send path

@@ -739,6 +739,235 @@ def decode_ts_response(frame: tuple):
         return None
 
 
+# ------------------------------------------------------------------- #
+# Distributed-collector frames (engines/crgc/distributed.py)
+#
+# The cross-node trace-wave protocol: boundary marks ("dmark") routed
+# point-to-point to the partition owner, cumulative-set acks ("dmack"),
+# wave control ("dwave"/"dfin"), Safra-style termination rounds over
+# the reduction tree ("dprobe"/"dstat"), the remote supervisor kill
+# gate ("dgate"/"dgack"), and the root dirty hint ("ddirty").  Same
+# tolerance contract as every subsystem frame family above: trailing
+# elements accepted, malformed -> None, unknown kinds ignored by old
+# peers after seq accounting.  Actor coordinates cross as JSON
+# ``[address, uid]`` pairs — data, never pickle — and re-bind through
+# ``resolve_cell_token`` at the receiver, so a frame from a newer peer
+# can at worst fail json.loads.
+# ------------------------------------------------------------------- #
+
+DIST_FRAME_KINDS = (
+    "dwave", "dmark", "dmack", "dprobe", "dstat", "dfin",
+    "dgate", "dgack", "ddirty", "djnl",
+)
+
+
+def encode_djournal(fence: int, partition: int, graph_bytes: bytes) -> tuple:
+    """A retained partition journal re-shipped to the partition's new
+    owner after a membership change (the absorb path); the payload is
+    the DeltaGraph wire format (DeltaGraph.java:189-232)."""
+    return ("djnl", int(fence), int(partition), graph_bytes)
+
+
+def decode_djournal(frame: tuple):
+    """-> (fence, partition, graph_bytes) or None."""
+    try:
+        payload = frame[3]
+        if not isinstance(payload, bytes):
+            return None
+        return int(frame[1]), int(frame[2]), payload
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def _keys_payload(keys) -> bytes:
+    return json.dumps([[a, int(u)] for a, u in keys]).encode()
+
+
+def _decode_keys(payload):
+    if not isinstance(payload, bytes):
+        return None
+    try:
+        raw = json.loads(payload)
+    except ValueError:
+        return None
+    if not isinstance(raw, list):
+        return None
+    keys = []
+    for item in raw:
+        try:
+            keys.append((str(item[0]), int(item[1])))
+        except (IndexError, TypeError, ValueError):
+            return None
+    return keys
+
+
+def encode_dwave(wave: int, fence: int, origin: str) -> tuple:
+    return ("dwave", int(wave), int(fence), origin)
+
+
+def decode_dwave(frame: tuple):
+    """-> (wave, fence, origin) or None."""
+    try:
+        return int(frame[1]), int(frame[2]), str(frame[3])
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_dmark(wave: int, fence: int, origin: str, keys) -> tuple:
+    return ("dmark", int(wave), int(fence), origin, _keys_payload(keys))
+
+
+def decode_dmark(frame: tuple):
+    """-> (wave, fence, origin, [(address, uid), ...]) or None."""
+    try:
+        keys = _decode_keys(frame[4])
+        if keys is None:
+            return None
+        return int(frame[1]), int(frame[2]), str(frame[3]), keys
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def _frame_fence(frame: tuple, index: int) -> int:
+    """Trailing fence element shared by the wave-keyed frames: wave ids
+    restart per partition era, so era-less frames could alias across a
+    membership change.  Absent (an older peer) decodes as era 0 —
+    tolerant both directions."""
+    try:
+        return int(frame[index])
+    except (IndexError, TypeError, ValueError):
+        return 0
+
+
+def encode_dmack(wave: int, origin: str, count: int, fence: int = 0) -> tuple:
+    return ("dmack", int(wave), origin, int(count), int(fence))
+
+
+def decode_dmack(frame: tuple):
+    """-> (wave, origin, count, fence) or None."""
+    try:
+        return (
+            int(frame[1]), str(frame[2]), int(frame[3]),
+            _frame_fence(frame, 4),
+        )
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_dprobe(wave: int, round_id: int, origin: str, fence: int = 0) -> tuple:
+    return ("dprobe", int(wave), int(round_id), origin, int(fence))
+
+
+def decode_dprobe(frame: tuple):
+    """-> (wave, round, origin, fence) or None."""
+    try:
+        return (
+            int(frame[1]), int(frame[2]), str(frame[3]),
+            _frame_fence(frame, 4),
+        )
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_dstat(
+    wave: int, round_id: int, origin: str, stats: dict, fence: int = 0
+) -> tuple:
+    return (
+        "dstat", int(wave), int(round_id), origin,
+        json.dumps(stats, default=repr).encode(), int(fence),
+    )
+
+
+def decode_dstat(frame: tuple):
+    """-> (wave, round, origin, stats_dict, fence) or None.  Unknown
+    stat keys pass through untouched (a newer peer may report more)."""
+    try:
+        payload = frame[4]
+        if not isinstance(payload, bytes):
+            return None
+        try:
+            stats = json.loads(payload)
+        except ValueError:
+            return None
+        if not isinstance(stats, dict):
+            return None
+        return (
+            int(frame[1]), int(frame[2]), str(frame[3]), stats,
+            _frame_fence(frame, 5),
+        )
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_dfin(wave: int, fence: int, origin: str) -> tuple:
+    return ("dfin", int(wave), int(fence), origin)
+
+
+def decode_dfin(frame: tuple):
+    """-> (wave, fence, origin) or None."""
+    try:
+        return int(frame[1]), int(frame[2]), str(frame[3])
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_dgate(wave: int, fence: int, origin: str, pairs) -> tuple:
+    """``pairs`` is [(sup_key, child_key), ...] with each key an
+    (address, uid) tuple."""
+    body = json.dumps(
+        [[s[0], int(s[1]), c[0], int(c[1])] for s, c in pairs]
+    ).encode()
+    return ("dgate", int(wave), int(fence), origin, body)
+
+
+def decode_dgate(frame: tuple):
+    """-> (wave, fence, origin, [((sup_addr, sup_uid), (child_addr,
+    child_uid)), ...]) or None."""
+    try:
+        payload = frame[4]
+        if not isinstance(payload, bytes):
+            return None
+        try:
+            raw = json.loads(payload)
+        except ValueError:
+            return None
+        pairs = []
+        for item in raw:
+            pairs.append(
+                ((str(item[0]), int(item[1])), (str(item[2]), int(item[3])))
+            )
+        return int(frame[1]), int(frame[2]), str(frame[3]), pairs
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_dgack(wave: int, origin: str, count: int, fence: int = 0) -> tuple:
+    return ("dgack", int(wave), origin, int(count), int(fence))
+
+
+def decode_dgack(frame: tuple):
+    """-> (wave, origin, count, fence) or None."""
+    try:
+        return (
+            int(frame[1]), str(frame[2]), int(frame[3]),
+            _frame_fence(frame, 4),
+        )
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+def encode_ddirty(origin: str) -> tuple:
+    return ("ddirty", origin)
+
+
+def decode_ddirty(frame: tuple):
+    """-> origin or None."""
+    try:
+        return str(frame[1])
+    except (IndexError, TypeError):
+        return None
+
+
 def encode_migration_ack(type_name: str, key: str, mig_id: tuple) -> tuple:
     return ("miga", type_name, key, tuple(mig_id))
 

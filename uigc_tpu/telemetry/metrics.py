@@ -569,6 +569,26 @@ class EventMetricsBridge:
             "Live peers observed serving alongside a member this node "
             "downed (the split_brain_suspected alert input).",
         )
+        self._dist_rounds = r.counter(
+            "uigc_dist_wave_rounds_total",
+            "Safra-style termination rounds judged by the distributed "
+            "collector's reduction-tree root (engines/crgc/distributed.py).",
+        )
+        self._dist_marks = r.counter(
+            "uigc_dist_marks_exchanged_total",
+            "Boundary marks shipped between partition owners as dmark "
+            "frames (cumulative sets: retransmits count), by dst.",
+        )
+        self._dist_boundary_edges = r.gauge(
+            "uigc_dist_boundary_edges",
+            "Edges of this node's owned shadow slice whose destination "
+            "lives on another node, at the last distributed sweep.",
+        )
+        self._dist_refolds = r.counter(
+            "uigc_dist_refolds_total",
+            "Partition journals re-folded after an ownership transfer "
+            "(the absorb-on-death path), by partition owner change.",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -703,6 +723,18 @@ class EventMetricsBridge:
             )
         elif name == events.MEMBERSHIP_DISAGREEMENT:
             self._membership_disagreements.inc()
+        elif name == events.DIST_ROUND:
+            self._dist_rounds.inc()
+        elif name == events.DIST_MARKS:
+            self._dist_marks.inc(
+                fields.get("count", 1) or 1, dst=fields.get("dst", "?")
+            )
+        elif name == events.DIST_WAVE:
+            edges = fields.get("boundary_edges")
+            if edges is not None:
+                self._dist_boundary_edges.set(edges)
+        elif name == events.DIST_REFOLD:
+            self._dist_refolds.inc()
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:

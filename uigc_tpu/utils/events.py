@@ -153,6 +153,37 @@ SHM_RING_FULL = "fabric.shm_ring_full"
 #: path (fields: dst, reason="peer-dead"|"poisoned"|"write-failed").
 SHM_FALLBACK = "fabric.shm_fallback"
 UNDO_FOLD = "crgc.undo_fold"
+#: an ingress-entry window from a pre-rejoin fence era was refused by
+#: the undo log (gateways.py (peer, fence) keying; fields: peer,
+#: ingress, window, fence, log_fence)
+STALE_WINDOW = "crgc.stale_window"
+
+# Distributed-collector events (engines/crgc/distributed.py): the
+# partitioned trace-wave protocol, observable end to end:
+#   crgc.dist_wave      one wave completed on this node (fields: wave,
+#                       node, garbage, live, rounds, marks_sent,
+#                       marks_recv, boundary_edges)
+#   crgc.dist_marks     one dmark frame left for a peer (fields: count,
+#                       dst, node) — cumulative sets, so retransmits
+#                       count too; feeds
+#                       uigc_dist_marks_exchanged_total
+#   crgc.dist_round     the root judged one Safra-style termination
+#                       round (fields: wave, round, settled, changed,
+#                       sent, recv, nodes) — feeds
+#                       uigc_dist_wave_rounds_total
+#   crgc.dist_refold    a partition's retained delta journal was
+#                       re-folded after an ownership transfer (fields:
+#                       partition, shadows, node, fence)
+#   crgc.dist_locality_violation
+#                       the per-sweep fold-locality audit found
+#                       authoritative state folded outside the owned
+#                       slice (fields: node, keys, count) — the runtime
+#                       twin of lint rule UL014; always a bug
+DIST_WAVE = "crgc.dist_wave"
+DIST_MARKS = "crgc.dist_marks"
+DIST_ROUND = "crgc.dist_round"
+DIST_REFOLD = "crgc.dist_refold"
+DIST_LOCALITY = "crgc.dist_locality_violation"
 
 # Cluster-sharding events (ours; uigc_tpu/cluster).  Emitted by the
 # shard regions and the migration machinery so rebalances are observable
