@@ -257,7 +257,7 @@ def test_cluster_frame_round_trip_property():
         )[4] == 0
         mig_id = (f"uigc://n{trial % 3}", trial)
         mig = wire.encode_migration_frame(
-            "counter", f"k{trial}", mig_id, payload, fence
+            "counter", f"k{trial}", mig_id, payload, fence, trial * 7
         )
         assert wire.decode_migration_frame(round_trip(mig)) == (
             "counter",
@@ -265,7 +265,11 @@ def test_cluster_frame_round_trip_property():
             mig_id,
             payload,
             fence,
+            trial * 7,
         )
+        # A PR-14 frame (no trailing epoch element) scans as epoch 0.
+        legacy_mig = ("mig", "counter", f"k{trial}", mig_id, payload, fence)
+        assert wire.decode_migration_frame(legacy_mig)[5] == 0
         ack = wire.encode_migration_ack("counter", f"k{trial}", mig_id)
         assert wire.decode_migration_ack(round_trip(ack)) == (
             "counter",
@@ -438,6 +442,51 @@ def test_two_node_join_migrates_live_state(event_log):
         terminate_all([n for n in (a, b) if n is not None])
 
 
+def test_deliver_local_rechecks_ownership_before_blank_spawn(event_log):
+    """The rebalance-under-traffic lost-incr race, pinned: a sender
+    thread that resolved the key's home BEFORE a handoff completed must
+    not blank-spawn the key at the OLD owner — deliver_local rechecks
+    the table at the spawn boundary and re-routes instead."""
+    config = dict(BASE)
+    config["uigc.crgc.num-nodes"] = 2
+    a = Node("recheck-a", config)
+    b = None
+    try:
+        b = Node("recheck-b", config)
+        a.fabric.connect("127.0.0.1", b.port)
+        assert settle(
+            lambda: len(a.cluster.members()) == 2
+            and len(b.cluster.members()) == 2
+            and a.cluster.table_snapshot().version
+            == b.cluster.table_snapshot().version,
+            timeout_s=15.0,
+        )
+        key = next(
+            k
+            for k in (f"k{i}" for i in range(400))
+            if a.cluster.home_of(k) == b.address
+        )
+        # Simulate the stale race deterministically: the caller's
+        # home_of read happened "before" the rebalance — deliver
+        # straight into A's region although the table names B.
+        a.region.deliver_local(key, ("incr",))
+        assert key not in a.region.record_keys()
+        forwarded = [
+            f
+            for f in event_log.of(events.SHARD_FORWARDED)
+            if f.get("site") == "spawn_recheck"
+        ]
+        assert forwarded and forwarded[0]["key"] == key
+        # The message re-routed to the real owner — nothing lost.
+        assert settle(lambda: b.region.active_count() == 1, timeout_s=15.0)
+        coll = Collector()
+        coll_cell = a.system.spawn_system_raw(coll, "coll")
+        a.cluster.entity_ref("counter", key).tell(("probe", coll_cell))
+        assert settle(lambda: coll.snapshot().get(key) == 1, timeout_s=15.0)
+    finally:
+        terminate_all([n for n in (a, b) if n is not None])
+
+
 def test_rebalance_under_traffic_loses_no_state(event_log):
     """The shard-grant protocol: a node join mid-traffic must not let
     an on-demand spawn at the new owner race (and discard) the in-flight
@@ -445,6 +494,13 @@ def test_rebalance_under_traffic_loses_no_state(event_log):
     counts — no state conflict, no loss."""
     config = dict(BASE)
     config["uigc.crgc.num-nodes"] = 2
+    # A loaded CI host can stretch the 60-key handoff past the default
+    # 3s hold-timeout, and an expired hold reopens the blank-spawn-vs-
+    # in-flight-snapshot race at the NEW owner (the old-owner side is
+    # closed by deliver_local's ownership recheck).  The timeout is a
+    # wedge safety valve, not a pacing device — give it slack, as the
+    # rolling-restart scenario already does.
+    config["uigc.cluster.hold-timeout"] = 15000
     a = Node("granta", config)
     b = None
     try:

@@ -236,6 +236,55 @@ def test_journal_epoch_supersedes_and_missing_snapshot_replays(tmp_path):
     j2.close()
 
 
+def test_open_epoch_min_epoch_supersedes_source_capture(tmp_path):
+    """The rolling-restart acked-highwater flake, pinned: a handoff's
+    destination activation must open STRICTLY past the source's
+    capture epoch even when the destination's shard scan is stale and
+    both land in the same wall-clock millisecond.  Without the floor,
+    the recovery merge sorts the source's capture snapshot past the
+    destination's later acked commands and replays short of them."""
+    import unittest.mock as mock
+
+    from uigc_tpu.cluster import journal as journal_mod
+
+    j_src = EntityJournal(str(tmp_path), "uigc://src", fsync="never")
+    j_dst = EntityJournal(str(tmp_path), "uigc://dst", fsync="never")
+    # Freeze the wall floor: every epoch decision lands "in the same
+    # millisecond", the regime where only the causal floor can order
+    # the two writers.
+    frozen = journal_mod._epoch_floor()
+    with mock.patch.object(journal_mod, "_epoch_floor", lambda: frozen):
+        j_src.open_epoch("t", 0, "k", b"S0")
+        for i in range(3):
+            j_src.note_command("t", 0, "k", b"C%d" % i)
+        # Prime the destination's shard scan BEFORE the capture: the
+        # stale view the real race depends on (shard indexes are
+        # cached between membership changes).
+        j_dst.keys_for_shard("t", 0)
+        cap = j_src.open_epoch("t", 0, "k", b"S3")  # migration capture
+        # Destination applies the shipped state, floor = the capture
+        # epoch that rode the mig frame.
+        dst_epoch = j_dst.open_epoch("t", 0, "k", b"S3", min_epoch=cap)
+        assert dst_epoch > cap
+        # Two more ACKED commands land at the destination.
+        j_dst.note_command("t", 0, "k", b"C3")
+        j_dst.note_command("t", 0, "k", b"C4")
+    # A fresh reader (the node inheriting the shard after a die())
+    # must replay the destination's acked tail on top of the shipped
+    # snapshot — not resurrect the source's capture as the base.
+    j_reader = EntityJournal(str(tmp_path), "uigc://rdr", fsync="never")
+    state, cmds = j_reader.recover("t", 0, "k")
+    assert state == b"S3" and cmds == [b"C3", b"C4"]
+    # Mixed-version tolerance: a PR-14 peer's mig frame carries no
+    # epoch element — it decodes as floor 0 and the wall/known floors
+    # apply exactly as before.
+    frame = ("mig", "t", "k", ("uigc://src", 1), b"blob", 0)
+    assert wire.decode_migration_frame(frame)[5] == 0
+    j_src.close()
+    j_dst.close()
+    j_reader.close()
+
+
 def test_journal_segment_roll_and_compaction(tmp_path):
     j = EntityJournal(
         str(tmp_path), "uigc://jc", fsync="never", segment_bytes=512,
@@ -327,8 +376,12 @@ def test_passivated_entities_survive_node_death(tmp_path, event_log):
             ref = a.cluster.entity_ref("counter", k)
             for _ in range(i + 1):
                 ref.tell(("incr",))
+        # All 24 exist (active OR already idled out — under full-suite
+        # load the 0.12s passivation can outrun the tail of the spawn
+        # burst, so a pure active_count==24 settle races by design).
         assert settle(
-            lambda: a.region.active_count() + b.region.active_count() == 24
+            lambda: a.region.active_count() + b.region.active_count()
+            + a.region.passive_count() + b.region.passive_count() == 24
         )
         # Idle out: every entity passivates (spilling through the
         # journal), leaving B with passivated-only state.

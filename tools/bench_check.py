@@ -13,6 +13,9 @@ Semantics per metric direction:
 - ``higher``  throughput-style: FAIL when new < prior * (1 - tol)
 - ``lower``   latency-style:    FAIL when new > prior * (1 + tol)
 - ``zero``    correctness tally (undercounts): FAIL when new > prior
+- ``floor``   absolute minimum: FAIL when new < tol (no trajectory —
+              an acceptance bar, e.g. partitioned/replicated >= 1.0)
+- ``ceiling`` absolute maximum: FAIL when new > tol
 
 A family with fewer than two committed runs is SKIPped (nothing to
 compare), as is a metric whose path stopped existing — bench shapes
@@ -125,11 +128,21 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
             Metric("trace.garbage_actors_per_sec", "higher", 0.40),
             Metric("trace.leaked_actors", "zero", 0.0),
             # Authoritative slots only: a hub actor's owner also holds
-            # bare mirrors of everything the hub references, so the
-            # resident-population fraction legitimately nears 1.0 on
-            # the single-master workload — the replica regression the
-            # band exists to catch shows up in the OWNED fraction.
+            # bare mirrors of everything the hub references; since the
+            # PR-15 mirror decay the RESIDENT fraction converges to
+            # ~the owned fraction too, and both are gated — owned by
+            # trajectory, resident by the absolute 0.7 acceptance bar.
             Metric("locality.max_node_owned_fraction", "lower", 0.60),
+            # r02+ (the PR-15 communication-plane rebuild): the
+            # partitioned trace must meet or beat the replicated fold
+            # measured in the SAME run, termination must stay in the
+            # 1-2 round regime, mark bytes get a trajectory band, and
+            # the resident-population bar catches full-replica
+            # regressions.  Rounds predating the keys SKIP honestly.
+            Metric("trace.speedup_vs_replicated", "floor", 1.0),
+            Metric("trace.rounds_per_wave", "ceiling", 2.5),
+            Metric("trace.boundary_mark_bytes_per_wave", "lower", 0.60),
+            Metric("locality.max_node_population_fraction", "ceiling", 0.70),
         ],
     ),
     # Device plane (telemetry/device.py + tools/device_report.py): the
@@ -199,6 +212,16 @@ def compare_metric(
     metric: Metric, prior: Optional[float], new: Optional[float]
 ) -> Tuple[str, str]:
     """-> (status, note).  status in PASS/FAIL/SKIP."""
+    if metric.direction in ("floor", "ceiling"):
+        # Absolute acceptance bars: judged on the newest round alone
+        # (the tolerance IS the bar), present-or-SKIP like any metric.
+        if new is None:
+            return "SKIP", "metric missing in newest"
+        if metric.direction == "floor" and new < metric.tolerance:
+            return "FAIL", f"below absolute floor {metric.tolerance:g}"
+        if metric.direction == "ceiling" and new > metric.tolerance:
+            return "FAIL", f"above absolute ceiling {metric.tolerance:g}"
+        return "PASS", "absolute bar"
     if metric.direction == "zero" and new is not None and prior is None:
         # A correctness tally is an absolute floor, not a trajectory:
         # its FIRST round must already be zero — a nonzero debut would
@@ -251,7 +274,7 @@ def check_family(
         new_round, new_path = runs[-1]
         new_doc = _load(new_path)
         for metric in metrics:
-            if metric.direction != "zero":
+            if metric.direction not in ("zero", "floor", "ceiling"):
                 rows.append(
                     {
                         "family": family, "metric": metric.path,

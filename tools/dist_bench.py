@@ -24,7 +24,17 @@ Prints one JSON object; commit as ``BENCH_DIST_r{N}.json`` (the
 bench_check DIST family bands ``trace.garbage_actors_per_sec`` and
 hard-zeroes ``trace.leaked_actors``).
 
-Usage: python tools/dist_bench.py [--rings 120] [--smoke] [--json PATH]
+Usage: python tools/dist_bench.py [--rings 120] [--waves 1]
+       [--payload 0] [--reps 1] [--smoke] [--json PATH]
+
+``--waves`` repeats the spawn/settle/drop/collect cycle (the drop
+phases aggregate into the headline rate, so the bench_check bands see
+a stable geometry instead of one cycle's jitter); ``--payload`` adds an
+inert bytes blob to every ring-closing Hold message (scales the wire
+traffic without changing the graph shape); ``--reps`` runs each phase
+N times and reports the best by garbage rate with every rep's rate
+listed (leaks are max-of, never hidden) — the whole collection is tens
+of milliseconds, so a single rep is at the mercy of host noise.
 """
 
 from __future__ import annotations
@@ -57,8 +67,9 @@ NODES = 3
 class Hold(Message):
     """Hand a worker the ref that closes its ring (wire-crossing)."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, blob=b""):
         self.ref = ref
+        self.blob = blob
 
     @property
     def refs(self):
@@ -66,8 +77,9 @@ class Hold(Message):
 
 
 class Go(NoRefs):
-    def __init__(self, rings: int):
+    def __init__(self, rings: int, payload: int = 0):
         self.rings = rings
+        self.payload = payload
 
 
 class Drop(NoRefs):
@@ -109,12 +121,13 @@ class Master(AbstractBehavior):
     def on_message(self, msg):
         ctx = self.context
         if isinstance(msg, Go):
+            blob = b"\x5a" * msg.payload
             for _ in range(msg.rings):
                 ring = [ctx.spawn_remote("worker", sc) for sc in self.spawners]
                 n = len(ring)
                 for i, w in enumerate(ring):
                     nxt = ring[(i + 1) % n]
-                    w.tell(Hold(ctx.create_ref(nxt, w)), ctx)
+                    w.tell(Hold(ctx.create_ref(nxt, w), blob), ctx)
                 self.workers.extend(ring)
         elif isinstance(msg, Drop):
             for w in self.workers:
@@ -147,55 +160,94 @@ def _build(distributed: bool, probe):
     return systems, master
 
 
-def _run_phase(rings: int, distributed: bool, timeout_s: float) -> dict:
+def _run_phase(
+    rings: int,
+    distributed: bool,
+    timeout_s: float,
+    waves: int = 1,
+    payload: int = 0,
+) -> dict:
     from uigc_tpu.runtime.testkit import TestProbe
 
     probe = TestProbe(default_timeout_s=timeout_s)
     systems, master = _build(distributed, probe)
     total = rings * NODES
+    peak_pop = [0] * NODES
+    peak_owned = [0] * NODES
+    frac = {"pop": 0.0, "owned": 0.0}
+
+    def sample():
+        pops, owned = [], []
+        for s in systems:
+            g = s.engine.bookkeeper.shadow_graph
+            pops.append(len(g.from_set))
+            owned.append(g.owned_population())
+        for i in range(NODES):
+            peak_pop[i] = max(peak_pop[i], pops[i])
+            peak_owned[i] = max(peak_owned[i], owned[i])
+        # Fractions are judged against the GLOBAL census at the same
+        # instant (every actor is owned exactly once, so the owned sum
+        # is the global authoritative population) — a static
+        # single-cycle denominator would let --waves carry-over (not-
+        # yet-swept shadows from the prior cycle) inflate a node past
+        # 1.0 and spuriously trip the bench_check ceiling.
+        total = max(sum(owned), 1)
+        frac["pop"] = max(frac["pop"], max(pops) / total)
+        frac["owned"] = max(frac["owned"], max(owned) / total)
+
     try:
-        master.tell(Go(rings))
-        for _ in range(total):
-            probe.expect_message_type(Spawned)
-        # Let the held refs' entries reach every owner before the drop.
-        time.sleep(0.3)
-        peak_pop = [0] * NODES
-        peak_owned = [0] * NODES
-        if distributed:
-            # Steady-state sample BEFORE the drop: this is the moment
-            # every ring is resident, so a full-replica regression
-            # (owned fraction ~1.0) cannot hide behind post-sweep
-            # sampling.  Note the master is a hub: its owner also holds
-            # a bare MIRROR for every worker it spawned (endpoints of
-            # the master's own edge list), so resident population on
-            # that one node approaches the global count by design —
-            # the ownership claim is about authoritative slots, which
-            # is what the owned fraction measures and the band gates.
-            for i, s in enumerate(systems):
-                g = s.engine.bookkeeper.shadow_graph
-                peak_pop[i] = max(peak_pop[i], len(g.from_set))
-                peak_owned[i] = max(peak_owned[i], g.owned_population())
-        t0 = time.monotonic()
-        master.tell(Drop())
         stopped = 0
-        deadline = t0 + timeout_s
-        while stopped < total and time.monotonic() < deadline:
+        elapsed = 0.0
+        for _cycle in range(max(1, waves)):
+            master.tell(Go(rings, payload))
+            for _ in range(total):
+                probe.expect_message_type(Spawned)
+            # Let the held refs' entries reach every owner (and the
+            # mirror-decay clock run) before the drop.
+            time.sleep(0.3)
+            if distributed:
+                # Steady-state sample BEFORE the drop: this is the
+                # moment every ring is resident, so a full-replica
+                # regression (population fraction ~1.0) cannot hide
+                # behind post-sweep sampling.  Pre-PR-15 the master's
+                # owner legitimately neared 1.0 here (a hub's owner
+                # held a bare mirror for every worker the master
+                # referenced); mirror decay now returns it to ~the
+                # owned fraction, which is what the band gates.
+                sample()
+            # Timed window with the cyclic collector paused (the PR 9
+            # finding: ~10^5 in-flight objects trigger gen-2 storms
+            # with ~100ms stalls — bimodal noise that swamps a
+            # tens-of-ms measurement; refcounting covers the window).
+            import gc as _gc
+
+            _gc_was_enabled = _gc.isenabled()
+            _gc.disable()
+            t0 = time.monotonic()
+            master.tell(Drop())
+            cycle_stopped = 0
+            deadline = t0 + timeout_s
             try:
-                probe.expect_message_type(Stopped)
-                stopped += 1
-            except Exception:
-                break
-            if distributed and stopped % 50 == 0:
-                for i, s in enumerate(systems):
-                    g = s.engine.bookkeeper.shadow_graph
-                    peak_pop[i] = max(peak_pop[i], len(g.from_set))
-        elapsed = max(time.monotonic() - t0, 1e-9)
-        if distributed:
-            for i, s in enumerate(systems):
-                g = s.engine.bookkeeper.shadow_graph
-                peak_pop[i] = max(peak_pop[i], len(g.from_set))
+                while cycle_stopped < total and time.monotonic() < deadline:
+                    try:
+                        probe.expect_message_type(Stopped)
+                        cycle_stopped += 1
+                    except Exception:
+                        break
+                    if distributed and cycle_stopped % 50 == 0:
+                        sample()
+                elapsed += max(time.monotonic() - t0, 1e-9)
+            finally:
+                if _gc_was_enabled:
+                    _gc.enable()
+            stopped += cycle_stopped
+            if distributed:
+                sample()
+        total = total * max(1, waves)
         out = {
             "rings": rings,
+            "cycles": max(1, waves),
+            "payload_bytes": payload,
             "garbage_actors": stopped,
             "leaked_actors": total - stopped,
             "seconds": round(elapsed, 4),
@@ -218,17 +270,13 @@ def _run_phase(rings: int, distributed: bool, timeout_s: float) -> dict:
             out["boundary_edges_peak"] = max(
                 d.get("boundary_edges", 0) for d in dumps
             )
-            # Workers + one spawner per node + the master; the probe
-            # rides its own system outside the cluster.
-            global_pop = rings * NODES + NODES + 1
+            out["mirrors_evicted_total"] = sum(
+                d.get("mirrors_evicted_total", 0) for d in dumps
+            )
             out["node_peak_populations"] = peak_pop
             out["node_peak_owned"] = peak_owned
-            out["max_node_population_fraction"] = round(
-                max(peak_pop) / max(global_pop, 1), 3
-            )
-            out["max_node_owned_fraction"] = round(
-                max(peak_owned) / max(global_pop, 1), 3
-            )
+            out["max_node_population_fraction"] = round(frac["pop"], 3)
+            out["max_node_owned_fraction"] = round(frac["owned"], 3)
         return out
     finally:
         for s in systems:
@@ -239,6 +287,27 @@ def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--rings", type=int, default=120)
     parser.add_argument(
+        "--waves",
+        type=int,
+        default=1,
+        help="spawn/drop cycles per phase (aggregated into one rate; "
+        "gives the bench_check bands a stable geometry)",
+    )
+    parser.add_argument(
+        "--payload",
+        type=int,
+        default=0,
+        help="inert bytes carried by every ring-closing Hold message",
+    )
+    parser.add_argument(
+        "--reps",
+        type=int,
+        default=1,
+        help="repetitions per phase, best-of by garbage rate (the "
+        "fabric_bench precedent: the workload is tens of ms, so a "
+        "single rep is at the mercy of host noise)",
+    )
+    parser.add_argument(
         "--smoke",
         action="store_true",
         help="small verify-gate run: a few rings, asserts zero leaks",
@@ -247,8 +316,35 @@ def main() -> int:
     args = parser.parse_args()
 
     rings = 6 if args.smoke else args.rings
+    waves = 1 if args.smoke else max(1, args.waves)
+    reps = 1 if args.smoke else max(1, args.reps)
     timeout_s = 60.0 if args.smoke else 180.0
-    dist = _run_phase(rings, distributed=True, timeout_s=timeout_s)
+
+    def best_of(distributed: bool) -> dict:
+        runs = [
+            _run_phase(
+                rings, distributed=distributed, timeout_s=timeout_s,
+                waves=waves, payload=args.payload,
+            )
+            for _ in range(reps)
+        ]
+        best = max(runs, key=lambda r: r["garbage_actors_per_sec"])
+        best["reps"] = reps
+        best["rep_rates"] = [r["garbage_actors_per_sec"] for r in runs]
+        # Correctness/structural tallies are WORST-of across reps:
+        # best-of may pick the fastest rate, but it must never hide a
+        # leak or a mirror-decay regression observed in another rep.
+        best["leaked_actors"] = max(r["leaked_actors"] for r in runs)
+        for key in (
+            "max_node_population_fraction",
+            "max_node_owned_fraction",
+        ):
+            vals = [r[key] for r in runs if r.get(key) is not None]
+            if key in best and vals:
+                best[key] = max(vals)
+        return best
+
+    dist = best_of(distributed=True)
     result = {
         "bench": "dist",
         "nodes": NODES,
@@ -266,15 +362,24 @@ def main() -> int:
         },
     }
     if not args.smoke:
-        repl = _run_phase(rings, distributed=False, timeout_s=timeout_s)
+        repl = best_of(distributed=False)
         result["replicated"] = repl
+        if repl["garbage_actors_per_sec"]:
+            # The headline acceptance ratio: >= 1.0 means the
+            # partitioned trace beats the replicated fold on the SAME
+            # run/host (bench_check DIST floors it at 1.0).
+            dist["speedup_vs_replicated"] = round(
+                dist["garbage_actors_per_sec"]
+                / repl["garbage_actors_per_sec"],
+                3,
+            )
     text = json.dumps(result, indent=2, sort_keys=True)
     print(text)
     if args.json:
         Path(args.json).write_text(text + "\n")
     if dist["leaked_actors"]:
         print(
-            f"FAIL: {dist['leaked_actors']} of {rings * NODES} "
+            f"FAIL: {dist['leaked_actors']} of {rings * NODES * waves} "
             "cross-node cycle actors never collected",
             file=sys.stderr,
         )

@@ -165,6 +165,9 @@ RULES = {
     "fenced helpers in cluster/sharding.py / cluster/journal.py",
     "UL014": "shadow-graph slot mutated outside the owning partition's "
     "fold path (route through the dmark/delta plane)",
+    "UL015": "dmark/dmack payload built outside the schema-codec "
+    "helpers (no ad-hoc frames or JSON coordinate lists on the "
+    "distributed hot path)",
 }
 
 #: UL012: attribute names that read as queues/buffers.  The rule fires
@@ -210,6 +213,15 @@ _SHADOW_FOLD_MODULES = (
     "engines/crgc/state.py",
     "analysis/sanitizer.py",
 )
+
+#: UL015: the boundary-mark frame kinds whose construction must stay
+#: inside runtime/wire.py (the frame layer) with payloads delegated to
+#: the runtime/schema.py key-set codec.  An ad-hoc ("dmark", ...) tuple
+#: elsewhere bypasses the density-switched binary payload AND the
+#: legacy-peer negotiation; a json.dumps/loads inside wire.py's
+#: dmark/dmack codecs re-creates the PR-14 JSON coordinate list the
+#: schema helpers replaced.
+_DMARK_FRAME_KINDS = {"dmark", "dmack"}
 
 #: UL009: unit suffixes a counter or histogram name must end with.
 _METRIC_UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio")
@@ -386,9 +398,16 @@ class _FileLinter:
             and "tests" not in parts
             and not norm.endswith(_SHADOW_FOLD_MODULES)
         )
+        dmark_plane = "uigc_tpu" in parts and "tests" not in parts
+        is_wire = norm.endswith("runtime/wire.py")
+        if is_wire:
+            self._lint_dmark_payload_json()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
                 self._lint_class(node)
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                if dmark_plane and not is_wire:
+                    self._lint_dmark_frame_literal(node)
             elif isinstance(node, ast.Call):
                 if not in_runtime:
                     self._lint_proxycell(node)
@@ -749,6 +768,54 @@ class _FileLinter:
                 f"shadow edge map .outgoing.{fn.attr}(...) outside the "
                 "fold plane; route through the dmark/delta plane",
             )
+
+    def _lint_dmark_frame_literal(self, node: ast.AST) -> None:
+        """UL015 (frame half): a ``("dmark", ...)``/``("dmack", ...)``
+        literal outside runtime/wire.py builds a boundary-mark frame by
+        hand — bypassing the payload codec, the suffix-watermark
+        elements and the legacy-peer negotiation the wire helpers
+        carry."""
+        elts = getattr(node, "elts", ())
+        if not elts:
+            return
+        head = elts[0]
+        if (
+            isinstance(head, ast.Constant)
+            and head.value in _DMARK_FRAME_KINDS
+        ):
+            self.add(
+                node.lineno,
+                "UL015",
+                f"ad-hoc ({head.value!r}, ...) frame literal; construct "
+                "boundary-mark frames through wire.encode_dmark/"
+                "encode_dmack",
+            )
+
+    def _lint_dmark_payload_json(self) -> None:
+        """UL015 (payload half): inside runtime/wire.py, the dmark/
+        dmack codec functions must delegate payload bytes to the
+        runtime/schema.py key-set helpers — a direct json.dumps/loads
+        there re-creates the ad-hoc JSON coordinate list on the hot
+        path."""
+        for node in self.tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name = node.name.lower()
+            if "dmark" not in name and "dmack" not in name:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                qual, fn_name = _call_name(call)
+                if qual == "json" and fn_name in ("dumps", "loads"):
+                    self.add(
+                        call.lineno,
+                        "UL015",
+                        f"json.{fn_name} inside {node.name}; dmark/dmack "
+                        "payloads go through the schema-codec key-set "
+                        "helpers (runtime/schema.py encode_keyset / "
+                        "decode_keyset_any)",
+                    )
 
     def _lint_unbounded_queue(self, node: ast.AST) -> None:
         """UL012: queue-shaped attributes in runtime//cluster/ must be

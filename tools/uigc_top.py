@@ -392,8 +392,10 @@ def render_dashboard(
         (
             ("uigc_dist_boundary_edges", "boundary-edges"),
             ("uigc_dist_marks_exchanged_total", "marks"),
+            ("uigc_dist_mark_bytes_total", "mark-bytes"),
             ("uigc_dist_wave_rounds_total", "rounds"),
             ("uigc_dist_refolds_total", "refolds"),
+            ("uigc_dist_mirror_evictions_total", "mirror-evicts"),
         ),
         show_at_zero=("uigc_dist_boundary_edges",),
     )

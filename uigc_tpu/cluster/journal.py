@@ -410,16 +410,30 @@ class EntityJournal:
     # -- region-facing API ---------------------------------------- #
 
     def open_epoch(
-        self, type_name: str, shard: int, key: str, state_blob: Optional[bytes]
+        self,
+        type_name: str,
+        shard: int,
+        key: str,
+        state_blob: Optional[bytes],
+        min_epoch: int = 0,
     ) -> int:
         """Activation-time snapshot: open a fresh epoch one past the
-        highest epoch visible for the key and write its base record."""
+        highest epoch visible for the key and write its base record.
+
+        ``min_epoch`` is a causal floor the fresh epoch must strictly
+        exceed — the migration path passes the SOURCE's capture epoch,
+        because "highest epoch visible" is a (cached) disk scan and the
+        wall-clock floor only has millisecond grain: a handoff applied
+        in the same millisecond as the source's capture, with a stale
+        scan, could otherwise open an epoch <= the capture's, and the
+        recovery merge would then sort the source's capture snapshot
+        PAST the destination's later acked commands and drop them."""
         known = self._known_epoch(type_name, shard, key)
         with self._lock:
             live = self._live.get((type_name, key))
             if live is not None and live[0] > known:
                 known = live[0]
-            epoch = max(known + 1, _epoch_floor())
+            epoch = max(known + 1, _epoch_floor(), min_epoch + 1)
             writer = self._writer(type_name, shard)
             self._live[(type_name, key)] = [epoch, 0, shard, writer.segment]
             self._append(type_name, shard, key, epoch, 0, _SNAP, state_blob)
@@ -491,16 +505,18 @@ class EntityJournal:
         with self._lock:
             self._append(type_name, shard, key, epoch, 0, _SNAP, state_blob)
 
-    def continue_epoch(self, type_name: str, shard: int, key: str) -> None:
+    def continue_epoch(self, type_name: str, shard: int, key: str) -> int:
         """Fallback when an activation could NOT produce a base
         snapshot (the state failed to encode): instead of opening a
         blank epoch — which would supersede a perfectly valid prior
         image — keep extending the highest existing epoch, so recovery
-        still replays the old snapshot plus every command since."""
+        still replays the old snapshot plus every command since.
+        Returns the epoch being extended."""
         known = self._known_epoch(type_name, shard, key)
         with self._lock:
-            if (type_name, key) in self._live:
-                return
+            live = self._live.get((type_name, key))
+            if live is not None:
+                return live[0]
             cache = self._recover_cache.get((type_name, shard), {})
             records = cache.get(key) or ()
             seq = max(
@@ -508,6 +524,7 @@ class EntityJournal:
             )
             writer = self._writer(type_name, shard)
             self._live[(type_name, key)] = [known, seq, shard, writer.segment]
+            return known
 
     def set_fence(self, fence: int) -> None:
         """Adopt a (higher) partition era; stamped on every later
@@ -768,6 +785,13 @@ class EntityJournal:
         cache = self._load_shard(type_name, shard)
         with self._lock:
             return sorted(cache)
+
+    def known_epoch(self, type_name: str, shard: int, key: str) -> int:
+        """Highest epoch visible for the key (as fresh as the last
+        cache invalidation) — the staleness probe the migration-apply
+        path uses: a shipped capture whose epoch is BELOW this predates
+        state some later incarnation already journaled."""
+        return self._known_epoch(type_name, shard, key)
 
     def recover(
         self, type_name: str, shard: int, key: str

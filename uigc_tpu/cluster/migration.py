@@ -148,16 +148,28 @@ class _Migration:
         "key",
         "mig_id",
         "blob",
+        "epoch",
         "started",
         "last_sent",
         "attempts",
     )
 
-    def __init__(self, region: "ShardRegion", key: str, mig_id: tuple, blob: bytes):
+    def __init__(
+        self,
+        region: "ShardRegion",
+        key: str,
+        mig_id: tuple,
+        blob: bytes,
+        epoch: int = 0,
+    ):
         self.region = region
         self.key = key
         self.mig_id = mig_id
         self.blob = blob
+        #: the source-side journal epoch of the captured state; ships
+        #: on the mig frame so the destination's activation epoch
+        #: strictly supersedes it
+        self.epoch = epoch
         self.started = time.monotonic()
         self.last_sent = 0.0
         self.attempts = 0
@@ -228,6 +240,7 @@ class MigrationManager:
     ) -> None:
         """Entity-thread completion of the capture: encode once, then
         ship (and keep for retries)."""
+        epoch = 0
         if region.cluster.journal is not None:
             # Journal checkpoint at the handoff boundary: the captured
             # snapshot (plus the drained-but-unprocessed pending tail)
@@ -237,7 +250,7 @@ class MigrationManager:
             # lock: the key is mid-HANDOFF, so no concurrent delivery
             # can interleave commands for it.
             try:
-                region._journal_open(key, snapshot)
+                epoch = region._journal_open(key, snapshot) or 0
                 for payload in pending:
                     region._journal_command(key, payload)
             except Exception:  # durability must not abort the handoff
@@ -246,7 +259,7 @@ class MigrationManager:
                 traceback.print_exc()
         blob = wire.encode_message((snapshot, pending))
         mig = _Migration(
-            region, key, (self.cluster.address, next(self._seq)), blob
+            region, key, (self.cluster.address, next(self._seq)), blob, epoch
         )
         with self._lock:
             self._pending[(region.type_name, key)] = mig
@@ -269,6 +282,7 @@ class MigrationManager:
             mig.mig_id,
             mig.blob,
             cluster.current_fence,
+            mig.epoch,
         )
         if home == cluster.address:
             # The table swung back to us (the target died mid-handoff):
@@ -347,7 +361,7 @@ class MigrationManager:
         decoded = wire.decode_migration_frame(frame)
         if decoded is None:
             return
-        type_name, key, mig_id, blob, fence = decoded
+        type_name, key, mig_id, blob, fence, src_epoch = decoded
         mig_id = tuple(mig_id)
         cluster = self.cluster
         if cluster._quarantined:
@@ -423,7 +437,10 @@ class MigrationManager:
                     # Our own bounced handoff (the table swung back
                     # before the target acked): the record is our
                     # tombstone, not a resident — reconstruct over it.
-                    region._reactivate(key, snapshot, pending, migrated=True)
+                    region._reactivate(
+                        key, snapshot, pending,
+                        migrated=True, min_epoch=src_epoch,
+                    )
                 else:
                     # A foreign snapshot colliding with our own in-
                     # flight capture: applying now could double-spawn
@@ -432,7 +449,9 @@ class MigrationManager:
                     return
             else:
                 region.store.pop(key)
-                region._reactivate(key, snapshot, pending, migrated=True)
+                region._reactivate(
+                    key, snapshot, pending, migrated=True, min_epoch=src_epoch
+                )
         with self._lock:
             self._remember(mig_id)
         self._ack(from_address, type_name, key, mig_id)

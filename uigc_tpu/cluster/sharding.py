@@ -445,30 +445,60 @@ class ShardRegion:
                 self._buffer_locked(key, payload)
                 return
             if rec is None:
-                snapshot = self.store.pop(key)
-                resumed = snapshot is not None
-                replay: Optional[List[Any]] = None
-                if snapshot is None and journal is not None:
-                    recovered = self._recover_from_journal(key)
-                    if recovered is not None:
-                        snapshot, replay = recovered
-                cell = self._spawn(
-                    key,
-                    snapshot,
-                    resumed=resumed,
-                    recovered=replay is not None,
-                )
-                rec = self._entities[key] = _EntityRecord(cell)
-                if replay:
-                    self._replay_commands(rec.cell, key, replay)
-            snap_epoch = None
-            if journal is not None and not isinstance(payload, _EntityCtl):
-                snap_epoch = self._journal_command(key, payload)
-            self._tell_entity(rec.cell, payload, raise_overflow)
-            if snap_epoch is not None:
-                rec.cell.tell_unbounded(
-                    _JournalSnapCmd(self, key, snap_epoch)
-                )
+                if self.cluster.home_of(key) not in (
+                    None,
+                    self.cluster.address,
+                ):
+                    # Ownership recheck at the spawn boundary: the
+                    # caller resolved the key's home BEFORE taking this
+                    # lock, and under full-suite load that read can
+                    # predate a whole completed handoff — the record is
+                    # gone because the entity now lives at the NEW
+                    # owner.  A blank on-demand spawn here would fork
+                    # the key's state at the OLD owner (the rebalance-
+                    # under-traffic lost-incr race); re-route by the
+                    # current table instead (outside the lock).
+                    reroute = True
+                else:
+                    reroute = False
+                    snapshot = self.store.pop(key)
+                    resumed = snapshot is not None
+                    replay: Optional[List[Any]] = None
+                    if snapshot is None and journal is not None:
+                        recovered = self._recover_from_journal(key)
+                        if recovered is not None:
+                            snapshot, replay = recovered
+                    cell = self._spawn(
+                        key,
+                        snapshot,
+                        resumed=resumed,
+                        recovered=replay is not None,
+                    )
+                    rec = self._entities[key] = _EntityRecord(cell)
+                    if replay:
+                        self._replay_commands(rec.cell, key, replay)
+            else:
+                reroute = False
+            if not reroute:
+                snap_epoch = None
+                if journal is not None and not isinstance(payload, _EntityCtl):
+                    snap_epoch = self._journal_command(key, payload)
+                self._tell_entity(rec.cell, payload, raise_overflow)
+                if snap_epoch is not None:
+                    rec.cell.tell_unbounded(
+                        _JournalSnapCmd(self, key, snap_epoch)
+                    )
+                return
+        if events.recorder.enabled:
+            events.recorder.commit(
+                events.SHARD_FORWARDED,
+                key=key,
+                type=self.type_name,
+                site="spawn_recheck",
+            )
+        self.cluster.route(
+            self.type_name, key, payload, hops=1, raise_overflow=raise_overflow
+        )
 
     def _replay_commands(self, cell: "ActorCell", key: str, replay: List[Any]) -> None:
         """Re-deliver a journal-recovered command tail through the
@@ -538,27 +568,34 @@ class ShardRegion:
             return journal.begin_snapshot(self.type_name, shard, key)
         return None
 
-    def _journal_open(self, key: str, snapshot: Any) -> None:
+    def _journal_open(
+        self, key: str, snapshot: Any, min_epoch: int = 0
+    ) -> Optional[int]:
         """Activation-time epoch open (fresh/resumed/migrated/
-        recovered state becomes the new base record).  An unencodable
+        recovered state becomes the new base record); returns the epoch
+        opened (None without a journal).  ``min_epoch`` is the causal
+        floor a migrated activation must strictly exceed — the source's
+        capture epoch shipped on the mig frame.  An unencodable
         snapshot must NOT open a blank epoch — that would supersede a
         valid prior image with nothing; extend the old epoch instead."""
         journal = self.cluster.journal
         if journal is None:
-            return
+            return None
         shard = self.cluster.shard_of_key(key)
         if snapshot is None:
-            journal.open_epoch(self.type_name, shard, key, None)
-            return
+            return journal.open_epoch(
+                self.type_name, shard, key, None, min_epoch=min_epoch
+            )
         try:
             blob = wire.encode_message(snapshot)
         except Exception:
             import traceback
 
             traceback.print_exc()
-            journal.continue_epoch(self.type_name, shard, key)
-            return
-        journal.open_epoch(self.type_name, shard, key, blob)
+            return journal.continue_epoch(self.type_name, shard, key)
+        return journal.open_epoch(
+            self.type_name, shard, key, blob, min_epoch=min_epoch
+        )
 
     def _journal_spill(self, key: str, state: Any) -> None:
         """StateStore durable backend: a passivated snapshot spills
@@ -639,6 +676,7 @@ class ShardRegion:
         resumed: bool = False,
         migrated: bool = False,
         recovered: bool = False,
+        min_epoch: int = 0,
     ) -> "ActorCell":
         """Construct the entity cell as a root actor (a pseudoroot: the
         region, not the GC, decides when it dies).  Caller holds the
@@ -679,8 +717,9 @@ class ShardRegion:
             )
         if cluster.journal is not None:
             # New incarnation, new epoch: the state this cell starts
-            # from becomes the journal's base record for the key.
-            self._journal_open(key, snapshot)
+            # from becomes the journal's base record for the key (for a
+            # migrated spawn, strictly past the source's capture epoch).
+            self._journal_open(key, snapshot, min_epoch=min_epoch)
         if migrated:
             tap = system.engine.tap
             if tap is not None:
@@ -732,7 +771,7 @@ class ShardRegion:
             return self._buffers.pop(key, [])
 
     def _reactivate(self, key: str, snapshot: Any, pending: List[Any],
-                    migrated: bool = False) -> None:
+                    migrated: bool = False, min_epoch: int = 0) -> None:
         """Install a fresh cell for ``key`` (post-migration apply, or a
         passivation that raced with new traffic) and deliver pending.
         With a journal, the spawn opened a fresh epoch from the shipped
@@ -742,14 +781,48 @@ class ShardRegion:
         the mailbox bound: shipped pending was already admitted (and
         possibly acked) at the source, buffered traffic already passed
         the region's buffer cap — shedding either would lose admitted
-        state; bounds re-apply to new traffic."""
+        state; bounds re-apply to new traffic.
+
+        Stale-copy guard: ``min_epoch`` is the source's capture epoch
+        (the mig frame's trailing element).  When the journal already
+        holds a HIGHER epoch for the key, the shipped snapshot predates
+        state a later incarnation journaled — a late retry of an old
+        handoff slipping past long-resolved holds (under load a mig
+        frame can wander for seconds).  Applying it would mint a fresh
+        wall-epoch base that permanently supersedes those acked
+        commands in every future recovery merge.  The journal is
+        authoritative there: reconstruct from it (fresh scan) and
+        deliver the shipped pending on top, surfaced as a structured
+        ``shard.state_conflict`` — never a silent regression."""
         journal = self.cluster.journal
+        replay: List[Any] = []
+        recovered_stale = False
+        if migrated and min_epoch and journal is not None:
+            shard = self.cluster.shard_of_key(key)
+            journal.invalidate_shard(self.type_name, shard)
+            if journal.known_epoch(self.type_name, shard, key) > min_epoch:
+                recovered_stale = True
         with self._lock:
+            if recovered_stale:
+                found = self._recover_from_journal(key, fresh=False)
+                if found is not None:
+                    snapshot, replay = found
+                    migrated = False  # journal state, not the stale blob
+                    if events.recorder.enabled:
+                        events.recorder.commit(
+                            events.SHARD_STATE_CONFLICT,
+                            key=key,
+                            type=self.type_name,
+                            src="stale-migration",
+                        )
             buffered = self._buffers.pop(key, [])
             cell = self._spawn(
-                key, snapshot, resumed=snapshot is not None, migrated=migrated
+                key, snapshot, resumed=snapshot is not None,
+                migrated=migrated, min_epoch=min_epoch,
             )
             self._entities[key] = _EntityRecord(cell)
+            for payload in replay:
+                self._redeliver(cell, key, payload, journal)
             for payload in pending:
                 self._redeliver(cell, key, payload, journal)
             for payload in buffered:

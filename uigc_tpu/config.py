@@ -88,6 +88,14 @@ DEFAULTS: Dict[str, Any] = {
     # with uigc.cluster.num-shards so entity placement and shadow
     # partitioning share one granularity (and one rendezvous family).
     "uigc.crgc.dist-partitions": 0,
+    # Mirror decay (distributed mode): a foreign-owned boundary mirror
+    # that no fold has mentioned for this many completed waves / idle
+    # wakes leaves the traversal working set (its shadow object stays
+    # pinned by the owned edges that reference it, so edge identity and
+    # fold cancellation are untouched).  Keeps hub nodes — whose owned
+    # actors reference most of the cluster — from converging to a full
+    # resident replica.  0 disables.
+    "uigc.crgc.mirror-decay-waves": 6,
     # Packed mutator->collector entry plane (SURVEY §7): flushes write
     # int64 rows into per-thread ring buffers instead of object Entries,
     # so the Bookkeeper's fold is pure array work.  Automatically falls

@@ -82,25 +82,36 @@ if TYPE_CHECKING:  # pragma: no cover
 
 
 class DWave:
-    __slots__ = ("wave", "fence", "origin")
+    __slots__ = ("wave", "fence", "origin", "round_id")
 
-    def __init__(self, wave: int, fence: int, origin: str):
+    def __init__(self, wave: int, fence: int, origin: str, round_id: int = 0):
         self.wave, self.fence, self.origin = wave, fence, origin
+        self.round_id = round_id
 
 
 class DMark:
-    __slots__ = ("wave", "fence", "origin", "keys")
+    __slots__ = ("wave", "fence", "origin", "keys", "start", "round_id")
 
-    def __init__(self, wave: int, fence: int, origin: str, keys: list):
+    def __init__(
+        self, wave: int, fence: int, origin: str, keys: list,
+        start: int = 0, round_id: int = 0,
+    ):
         self.wave, self.fence, self.origin, self.keys = wave, fence, origin, keys
+        self.start = start
+        self.round_id = round_id
 
 
 class DMack:
-    __slots__ = ("wave", "origin", "count", "fence")
+    __slots__ = ("wave", "origin", "count", "fence", "round_id", "report")
 
-    def __init__(self, wave: int, origin: str, count: int, fence: int = 0):
+    def __init__(
+        self, wave: int, origin: str, count: int, fence: int = 0,
+        round_id: int = 0, report=None,
+    ):
         self.wave, self.origin, self.count = wave, origin, count
         self.fence = fence
+        self.round_id = round_id
+        self.report = report
 
 
 class DProbe:
@@ -211,14 +222,87 @@ class PartitionedShadowGraph(ShadowGraph):
         self.fold_touched: Set[Tuple[str, int]] = set()
         #: last audited boundary-edge count (telemetry gauge)
         self.boundary_edges = 0
+        #: mirror-decay clock (ticks once per completed wave / idle
+        #: wake) and the decayed mirrors parked outside the traversal
+        #: working set: cell -> Shadow.  A decayed mirror's OBJECT stays
+        #: alive inside its referencing owners' ``outgoing`` dicts (so
+        #: edge identity is preserved and later +/-1 folds cancel), but
+        #: it leaves ``from_set``/``key_index`` — the per-wave iteration
+        #: and population surface — until ownership changes or its last
+        #: referencing edge releases.
+        self.decay_tick = 0
+        self.evicted: Dict[Any, Any] = {}
+        self.mirrors_evicted_total = 0
 
     # -- partition plumbing ---------------------------------------- #
 
     def set_partition_map(self, pmap: PartitionMap) -> None:
         self.partition_map = pmap
         # Ownership moved: stale locality records would false-positive
-        # against the new map.
+        # against the new map, and a decayed mirror may now be OWNED —
+        # its authoritative slot must be back in the working set before
+        # the absorb path resets/re-folds the gained slices.
         self.fold_touched.clear()
+        self._revive_evicted()
+
+    def _revive_evicted(self) -> None:
+        """Re-admit every decayed mirror to the working set (called at
+        each partition remap: a gained partition's shadows must be
+        visible to ``reset_partition`` and the re-fold; still-foreign
+        ones simply decay again)."""
+        if not self.evicted:
+            return
+        tick = self.decay_tick
+        for cell, shadow in self.evicted.items():
+            shadow.touch_tick = tick
+            self.from_set.append(shadow)
+            self.key_index[cell_key(cell)] = cell
+        self.evicted = {}
+
+    def decay_mirrors(self, max_age: int) -> int:
+        """Advance the decay clock and move foreign-owned mirrors that
+        no fold has mentioned for ``max_age`` ticks out of the working
+        set.  Relay correctness is untouched: the fixpoint reaches a
+        mirror through its referencing owner's ``outgoing`` dict and
+        relays by key — residency in ``from_set``/``key_index`` is pure
+        iteration/population surface (the hub-node full-replica
+        convergence this decays away).
+
+        The O(population) scan runs only every ``max_age`` ticks — a
+        shadow cannot expire sooner than one full window after its
+        last touch — so idle collector wakes pay amortized
+        O(pop / max_age), never a full sweep per 10ms tick."""
+        pmap = self.partition_map
+        if max_age <= 0 or pmap is None:
+            return 0
+        self.decay_tick += 1
+        if self.decay_tick % max_age:
+            return 0
+        floor = self.decay_tick - max_age
+        keep: List[Any] = []
+        evicted = self.evicted
+        n = 0
+        for shadow in self.from_set:
+            if (
+                shadow.touch_tick <= floor
+                and not self.owns_shadow(shadow)
+            ):
+                cell = shadow.self_cell
+                evicted[cell] = shadow
+                self.key_index.pop(cell_key(cell), None)
+                n += 1
+                continue
+            keep.append(shadow)
+        if n:
+            self.from_set = keep
+            self.mirrors_evicted_total += n
+            events.recorder.commit(
+                events.DIST_MIRROR_EVICT,
+                count=n,
+                resident=len(keep),
+                node=self.local_address,
+            )
+        return n
 
     def owns_key(self, key: Tuple[str, int]) -> bool:
         pmap = self.partition_map
@@ -246,11 +330,13 @@ class PartitionedShadowGraph(ShadowGraph):
 
     def make_shadow(self, cell):
         shadow = super().make_shadow(cell)
+        shadow.touch_tick = self.decay_tick
         self.key_index[cell_key(cell)] = cell
         return shadow
 
     def drop_shadow(self, cell) -> None:
         self.shadow_map.pop(cell, None)
+        self.evicted.pop(cell, None)
         self.key_index.pop(cell_key(cell), None)
 
     def shadow_for_key(self, key: Tuple[str, int]):
@@ -266,11 +352,29 @@ class PartitionedShadowGraph(ShadowGraph):
         # BEFORE folding: a content-bearing delta shadow (flags,
         # balance, supervisor, or edges) mutates its actor's slot; a
         # bare mention only ensures existence.
+        # One pass over the decoder does double duty: record the
+        # content-bearing keys for the locality audit, and refresh the
+        # mirror-decay clock for every RESIDENT shadow the delta
+        # mentions ("an owned edge touched it").  A decayed mirror is
+        # deliberately NOT revived — ``get_shadow`` resolves it through
+        # ``shadow_map``, so edge identity (and +/-1 fold cancellation)
+        # is preserved without re-growing the working set; shadows the
+        # fold CREATES get their tick in ``make_shadow``.
         decoder = delta.decoder()
         touched = self.fold_touched
+        tick = self.decay_tick
+        smap = self.shadow_map
+        evicted = self.evicted
         for i, ds in enumerate(delta.shadows):
+            cell = decoder[i]
+            if cell is None:
+                continue
             if ds.interned or ds.recv_count or ds.supervisor >= 0 or ds.outgoing:
-                touched.add(cell_key(decoder[i]))
+                touched.add(cell_key(cell))
+            if cell not in evicted:
+                shadow = smap.get(cell)
+                if shadow is not None:
+                    shadow.touch_tick = tick
         super().merge_delta(delta)
 
     def merge_undo_log(self, log) -> None:
@@ -367,23 +471,31 @@ class PartitionedShadowGraph(ShadowGraph):
 class _WaveState:
     __slots__ = (
         "wave", "fence", "marked", "queue", "seeded",
-        "out_marks", "out_sets", "acked", "recv_keys",
+        "out_marks", "out_sets", "sent_upto", "acked",
+        "recv_upto", "recv_ahead",
         "changed", "reported_round", "probe_round_seen", "child_stats",
         "fin", "idle",
         # root only
-        "probe_round", "round_done", "clean_rounds", "rounds_run",
+        "probe_round", "round_done", "quiet_sig", "rounds_run",
     )
 
     def __init__(self, wave: int, fence: int):
         self.wave = wave
         self.fence = fence
-        self.marked: Set[Any] = set()          # Shadow objects
+        self.marked: Set[Any] = set()          # Shadow objects (owned)
         self.queue: List[Any] = []             # pending propagation
         self.seeded = False
         self.out_marks: Dict[str, List] = {}   # peer -> ordered key list
         self.out_sets: Dict[str, Set] = {}     # peer -> key set (dedup)
+        #: peer -> flush watermark (keys [0:sent_upto] already flushed
+        #: this wave; the suffix protocol sends only past it)
+        self.sent_upto: Dict[str, int] = {}
+        #: peer -> acked contiguous-coverage watermark
         self.acked: Dict[str, int] = {}
-        self.recv_keys: Dict[str, Set] = {}    # src -> key set
+        #: src -> contiguous received-position watermark
+        self.recv_upto: Dict[str, int] = {}
+        #: src -> out-of-order positions past the watermark
+        self.recv_ahead: Dict[str, Set[int]] = {}
         self.changed = False
         self.reported_round = 0
         self.probe_round_seen = 0
@@ -392,14 +504,23 @@ class _WaveState:
         self.idle = 0
         self.probe_round = 0
         self.round_done: Dict[int, bool] = {}
-        self.clean_rounds = 0
+        #: the (sent, recv) signature of the last judged all-settled
+        #: sent==recv round; an identical signature on the NEXT judged
+        #: round proves the global fixpoint (the two-consecutive-quiet
+        #: criterion — Mattern's four-counter argument over idempotent
+        #: cumulative mark sets)
+        self.quiet_sig: Optional[tuple] = None
         self.rounds_run = 0
 
     def sent_total(self) -> int:
         return sum(len(lst) for lst in self.out_marks.values())
 
     def recv_total(self) -> int:
-        return sum(len(s) for s in self.recv_keys.values())
+        srcs = set(self.recv_upto) | set(self.recv_ahead)
+        return sum(
+            self.recv_upto.get(s, 0) + len(self.recv_ahead.get(s, ()))
+            for s in srcs
+        )
 
     def settled(self) -> bool:
         if self.queue:
@@ -447,6 +568,11 @@ class DistributedBookkeeper(Bookkeeper):
         self._pending_journals: List[DJournal] = []
         self._pending_undo: List[Any] = []
         self._dirty_hint = False
+        #: re-entrancy latch for sweep -> next-wave chaining
+        self._chain_guard = False
+        #: foreign-owned mirrors leave the traversal working set after
+        #: this many decay ticks without a fold touching them (0 = off)
+        self.mirror_decay = config.get_int("uigc.crgc.mirror-decay-waves")
         #: remote-supervisor kill gates from the last sweep, re-derived
         #: per wave; unacked gates keep the graph dirty so the next
         #: wave retries (a lost frame delays, never leaks a kill
@@ -729,6 +855,9 @@ class DistributedBookkeeper(Bookkeeper):
             self._on_dgack(msg)
         elif isinstance(msg, DDirty):
             self._dirty_hint = True
+            # Event-driven wave start: the root opens the wave the
+            # moment the hint lands instead of on its next timer wake.
+            self._maybe_begin_wave()
         elif isinstance(msg, DJournal):
             self._on_djournal(msg)
         else:
@@ -986,32 +1115,33 @@ class DistributedBookkeeper(Bookkeeper):
         return False
 
     def _wave_step(self) -> int:
+        """The per-wake driver.  Since the pipelined rework this is the
+        RETRANSMIT / healing plane: marks, acks, probes and reports all
+        fire event-driven as frames arrive (:meth:`_pump`), so a
+        healthy wave converges at message latency; the wake re-drives
+        whatever a dropped frame stalled."""
         if self.pmap is None or not self.started:
             return 0
         n_garbage = 0
         if self.ws is None:
             self._fold_pending()
             self._resend_gates()
-            if self._is_root():
-                if self._graph_dirty or self._dirty_hint or self._gates_pending():
-                    self._start_wave()
-            elif self._graph_dirty or self._gates_pending():
-                root = self.tree.root
-                if root is not None and root != self._me:
-                    self._send_dist(
-                        root, wire.encode_ddirty(self._me), DDirty(self._me)
-                    )
+            self._maybe_begin_wave()
+            self._graph().decay_mirrors(self.mirror_decay)
         ws = self.ws
         if ws is not None:
             self._fixpoint(ws)
-            self._send_dmarks(ws)
+            self._send_dmarks(ws, retransmit=True)
             if self._is_root():
-                # Keep late joiners / dropped dwave frames in the wave.
+                # Keep late joiners / dropped dwave frames in the wave
+                # (the round stamp rides along — dprobe's fallback).
                 for peer in self.remote_gcs:
                     self._send_dist(
                         peer,
-                        wire.encode_dwave(ws.wave, ws.fence, self._me),
-                        DWave(ws.wave, ws.fence, self._me),
+                        wire.encode_dwave(
+                            ws.wave, ws.fence, self._me, ws.probe_round
+                        ),
+                        DWave(ws.wave, ws.fence, self._me, ws.probe_round),
                     )
                 self._root_termination(ws)
             self._flush_stat_report(ws)
@@ -1033,6 +1163,35 @@ class DistributedBookkeeper(Bookkeeper):
                 n_garbage = self._sweep(ws)
         return n_garbage
 
+    def _maybe_begin_wave(self) -> None:
+        """Start (root) or solicit (non-root) a wave when dirty work is
+        waiting and none is in flight."""
+        if self.ws is not None or self.pmap is None or not self.started:
+            return
+        if self._is_root():
+            if self._graph_dirty or self._dirty_hint or self._gates_pending():
+                self._start_wave()
+                ws = self.ws
+                if ws is not None:
+                    self._pump(ws)
+        elif self._graph_dirty or self._gates_pending():
+            root = self.tree.root
+            if root is not None and root != self._me:
+                self._send_dist(
+                    root, wire.encode_ddirty(self._me), DDirty(self._me)
+                )
+
+    def _pump(self, ws: _WaveState) -> None:
+        """One event-driven propagation step: drain the local fixpoint,
+        flush fresh boundary marks, push the termination machinery.
+        Called from every protocol-frame handler, so mark propagation
+        crosses the cluster at message latency instead of one hop per
+        collector wake — the latency collapse that lets the partitioned
+        trace outrun the replicated fold."""
+        self._fixpoint(ws)
+        self._send_dmarks(ws)
+        self._finish_pump(ws)
+
     def _start_wave(self) -> None:
         self._fold_pending()
         self.wave += 1
@@ -1051,6 +1210,13 @@ class DistributedBookkeeper(Bookkeeper):
         announced.  A HIGHER fence is adopted first (our membership
         view lags — see _adopt_fence); frames from an older era are
         ignored — the sender re-ships once its view converges."""
+        if self.pmap is None:
+            # Join race: a peer whose membership completed first can
+            # open a wave before our partition map exists.  Refuse the
+            # wave (no state to trace against, and the mark handlers
+            # consult the map); the sender's wake-driven retransmits
+            # re-deliver once our remap lands.
+            return False
         if fence > self.fence:
             self._adopt_fence(fence)
         if fence != self.fence:
@@ -1078,9 +1244,11 @@ class DistributedBookkeeper(Bookkeeper):
 
     def _fixpoint(self, ws: _WaveState) -> None:
         """Drain the wave's propagation queue: local push over owned
-        slots, boundary marks accumulated per owner.  (The pointer
-        plane's analogue of one PR 6 sweep batch; seeds arriving later
-        in the wave re-enter here.)"""
+        slots.  Marks crossing a partition boundary never enter the
+        queue — they are propagation-blocked straight into the
+        per-owner mark buffer at push time (``_relay_mark``), so each
+        drain costs one buffer append per boundary edge and the flush
+        is O(owners) frames, not O(pending batches)."""
         g = self._graph()
         if not ws.seeded:
             ws.seeded = True
@@ -1097,57 +1265,127 @@ class DistributedBookkeeper(Bookkeeper):
         if not queue:
             return
         marked = ws.marked
-        me = self._me
+        owned = self._owned
+        relay = self._relay_mark
         progressed = False
         while queue:
             shadow = queue.pop()
             progressed = True
-            if not self._owned(shadow):
-                # A mark reached a mirror: relay to the owner, never
-                # propagate through non-authoritative state.
-                key = cell_key(shadow.self_cell)
-                owner = self.pmap.owner_of(key)
-                if owner is not None and owner != me:
-                    s = ws.out_sets.setdefault(owner, set())
-                    if key not in s:
-                        s.add(key)
-                        ws.out_marks.setdefault(owner, []).append(key)
-                continue
             if shadow.is_halted:
                 continue
             for target, count in shadow.outgoing.items():
                 if count > 0 and target not in marked:
-                    marked.add(target)
-                    queue.append(target)
+                    if owned(target):
+                        marked.add(target)
+                        queue.append(target)
+                    else:
+                        relay(ws, target)
             sup = shadow.supervisor
             if sup is not None and sup not in marked:
-                marked.add(sup)
-                queue.append(sup)
+                if owned(sup):
+                    marked.add(sup)
+                    queue.append(sup)
+                else:
+                    relay(ws, sup)
         if progressed:
             ws.changed = True
 
-    def _send_dmarks(self, ws: _WaveState) -> None:
-        """Cumulative re-send until acked: drops, dups and reorders all
-        degrade to a retransmit of an idempotent set union."""
+    def _relay_mark(self, ws: _WaveState, shadow: Any) -> None:
+        """A mark reached a mirror: buffer its key for the owner (dedup
+        per wave), never propagate through non-authoritative state."""
+        self._relay_key(ws, cell_key(shadow.self_cell))
+
+    def _relay_key(self, ws: _WaveState, key: Tuple[str, int]) -> None:
+        owner = self.pmap.owner_of(key)
+        if owner is None or owner == self._me:
+            return
+        s = ws.out_sets.setdefault(owner, set())
+        if key not in s:
+            s.add(key)
+            ws.out_marks.setdefault(owner, []).append(key)
+
+    def _keyset_capable(self, peer: str) -> bool:
+        """Can ``peer`` decode the binary key-set payload?  NodeFabric
+        peers advertise SCHEMA_DIST_KEYS through the schema-codec hello
+        caps (PR 9); the in-process fabric is the same build by
+        construction.  A legacy peer gets the PR-14 JSON shape."""
+        fabric = self.engine.system.fabric
+        ids_fn = getattr(fabric, "peer_schema_ids", None)
+        if ids_fn is None:
+            return True
+        from ...runtime import schema as wire_schema
+
+        return wire_schema.SCHEMA_DIST_KEYS in ids_fn(peer)
+
+    def _round_stamp(self, ws: _WaveState) -> int:
+        return ws.probe_round if self._is_root() else ws.probe_round_seen
+
+    def _send_dmarks(self, ws: _WaveState, retransmit: bool = False) -> None:
+        """Flush boundary marks, one frame per owner.  Schema-capable
+        peers get the suffix protocol: each flush carries only the keys
+        past the flush watermark, binary-encoded; the per-wake
+        ``retransmit`` pass re-covers the span past the peer's ACK
+        watermark, so drops, dups and reorders all degrade to a
+        retransmit of an idempotent, position-addressed set union.
+        Legacy (PR-14) peers get the old full-cumulative JSON frame."""
         for peer, lst in ws.out_marks.items():
-            if ws.acked.get(peer, 0) >= len(lst):
-                continue
-            frame = wire.encode_dmark(ws.wave, ws.fence, self._me, lst)
-            self._send_dist(
-                peer, frame, DMark(ws.wave, ws.fence, self._me, list(lst))
-            )
-            self.marks_sent += len(lst)
+            total = len(lst)
+            acked = ws.acked.get(peer, 0)
+            upto = ws.sent_upto.get(peer, 0)
+            if self._keyset_capable(peer):
+                start = upto
+                if retransmit and acked < upto:
+                    start = acked
+                if start >= total:
+                    continue
+                chunk = lst[start:]
+                frame = wire.encode_dmark(
+                    ws.wave, ws.fence, self._me, chunk,
+                    start=start, binary=True,
+                    round_id=self._round_stamp(ws),
+                )
+                msg = DMark(
+                    ws.wave, ws.fence, self._me, list(chunk),
+                    start, self._round_stamp(ws),
+                )
+            else:
+                if acked >= total:
+                    continue
+                if not retransmit and upto >= total:
+                    continue
+                chunk = lst
+                frame = wire.encode_dmark(
+                    ws.wave, ws.fence, self._me, lst, binary=False
+                )
+                msg = DMark(ws.wave, ws.fence, self._me, list(lst))
+            self._send_dist(peer, frame, msg)
+            ws.sent_upto[peer] = total
+            self.marks_sent += len(chunk)
             self.mark_bytes += len(frame[4])
             events.recorder.commit(
                 events.DIST_MARKS,
-                count=len(lst),
+                count=len(chunk),
                 bytes=len(frame[4]),
                 dst=peer,
                 node=self._me,
             )
 
+    def _note_round(self, ws: _WaveState, round_id: int) -> None:
+        """Epidemic round dissemination: every dwave/dmark/dmack frame
+        carries the sender's known termination round, so non-roots
+        learn the round from the data plane and explicit dprobe frames
+        become the drop-healing fallback."""
+        if round_id and not self._is_root() and round_id > ws.probe_round_seen:
+            ws.probe_round_seen = round_id
+
     def _on_dwave(self, msg: DWave) -> None:
-        self._enter_wave(msg.wave, msg.fence)
+        if not self._enter_wave(msg.wave, msg.fence):
+            return
+        ws = self.ws
+        if ws is None or ws.wave != msg.wave:
+            return
+        self._note_round(ws, msg.round_id)
+        self._pump(ws)
 
     def _on_dmark(self, msg: DMark) -> None:
         if not self._enter_wave(msg.wave, msg.fence):
@@ -1155,29 +1393,66 @@ class DistributedBookkeeper(Bookkeeper):
         ws = self.ws
         if ws is None or ws.wave != msg.wave:
             return
+        self._note_round(ws, msg.round_id)
         g = self._graph()
-        seen = ws.recv_keys.setdefault(msg.origin, set())
-        new = 0
+        up = ws.recv_upto.get(msg.origin, 0)
+        ahead = ws.recv_ahead.setdefault(msg.origin, set())
+        # Seed EVERY key in the frame (idempotent via ws.marked):
+        # positions below track coverage of the sender's mark list as
+        # SPANS only — the binary codec re-orders keys inside a frame
+        # (address-grouped, uid-sorted), so per-position key identity
+        # is not stable across differently-bounded retransmits, and
+        # skipping "already covered" positions key-by-key could drop a
+        # mark whose position was covered by a frame that carried a
+        # DIFFERENT key there.  A frame's key set is exactly the
+        # sender's list[start:start+n] as a set, so span coverage <=>
+        # every one of those keys delivered, in any order.
         for key in msg.keys:
-            key = (key[0], int(key[1]))
-            if key in seen:
+            k = (key[0], int(key[1]))
+            if not self.pmap.owns(k):
+                # Misrouted mark: the sender's partition map disagrees
+                # with ours (the _adopt_fence window re-stamps a stale
+                # member view at the adopted fence, so two maps can
+                # share a fence with divergent ownership).  Forward by
+                # OUR map instead of consuming through a mirror — the
+                # relay converges as the views do, and a live actor's
+                # mark can never be silently absorbed short of its
+                # true owner.
+                self._relay_key(ws, k)
                 continue
-            seen.add(key)
-            new += 1
-            shadow = g.shadow_for_key(key)
+            shadow = g.shadow_for_key(k)
             if shadow is not None and shadow not in ws.marked:
                 ws.marked.add(shadow)
                 ws.queue.append(shadow)
+        new = 0
+        for pos in range(msg.start, msg.start + len(msg.keys)):
+            if pos < up or pos in ahead:
+                continue
+            ahead.add(pos)
+            new += 1
+        while up in ahead:
+            ahead.discard(up)
+            up += 1
+        ws.recv_upto[msg.origin] = up
         if new:
             ws.changed = True
             self.marks_received += new
-        # Always ack with the cumulative count — a duplicate frame's
-        # ack heals a lost earlier ack.
+        # Propagate BEFORE acking: the fixpoint drains synchronously,
+        # so the ack's piggybacked report (and the termination stats it
+        # reflects) already cover the seeds this frame delivered.
+        self._fixpoint(ws)
+        self._send_dmarks(ws)
+        # Always ack with the contiguous watermark — a duplicate
+        # frame's ack heals a lost earlier ack.
+        rid, report = self._piggyback_report(ws, msg.origin)
         self._send_dist(
             msg.origin,
-            wire.encode_dmack(ws.wave, self._me, len(seen), self.fence),
-            DMack(ws.wave, self._me, len(seen), self.fence),
+            wire.encode_dmack(
+                ws.wave, self._me, up, self.fence, rid, report
+            ),
+            DMack(ws.wave, self._me, up, self.fence, rid, report),
         )
+        self._finish_pump(ws)
 
     def _on_dmack(self, msg: DMack) -> None:
         if msg.fence != self.fence:
@@ -1186,11 +1461,78 @@ class DistributedBookkeeper(Bookkeeper):
         ws = self.ws
         if ws is None or ws.wave != msg.wave:
             return
+        self._note_round(ws, msg.round_id)
         prev = ws.acked.get(msg.origin, 0)
         if msg.count > prev:
             ws.acked[msg.origin] = msg.count
+        if (
+            msg.report is not None
+            and msg.round_id > 0
+            and self.tree is not None
+            and msg.origin in self.tree.children(self._me)
+            and not self.tree.children(msg.origin)
+        ):
+            # A leaf child's termination report rode the ack.
+            settled, changed, sent, recv, nodes = msg.report
+            ws.child_stats.setdefault(msg.round_id, {})[msg.origin] = {
+                "settled": bool(settled),
+                "changed": bool(changed),
+                "sent": sent,
+                "recv": recv,
+                "nodes": nodes,
+            }
+        self._pump(ws)
+
+    def _piggyback_report(self, ws: _WaveState, peer: str):
+        """(round stamp, report-or-None) for an outgoing dmack: a LEAF
+        whose parent is the ack's destination attaches its settled
+        report for the current round, so the common termination path
+        needs no explicit dstat frame at all."""
+        rid = self._round_stamp(ws)
+        if (
+            self.tree is None
+            or self._is_root()
+            or peer != self.tree.parent(self._me)
+            or self.tree.children(self._me)
+            or rid <= ws.reported_round
+            or not ws.settled()
+        ):
+            return rid, None
+        agg = self._own_stats(ws)
+        ws.reported_round = rid
+        return rid, (
+            int(agg["settled"]), int(agg["changed"]),
+            agg["sent"], agg["recv"], agg["nodes"],
+        )
 
     # -- termination (Safra over the reduction tree) ----------------- #
+
+    def _finish_pump(self, ws: _WaveState) -> None:
+        """Termination tail of one pump: judge/report, and when the
+        wave finished, sweep NOW (not on the next timer wake) and chain
+        straight into the next wave if dirty work is already waiting —
+        the pipelining that removes every wake-interval barrier from
+        the wave lifecycle."""
+        if self._is_root():
+            self._root_termination(ws)
+        else:
+            self._flush_stat_report(ws)
+        if ws.fin and self.ws is ws:
+            n_garbage = self._sweep(ws)
+            self._after_wake(n_garbage)
+            self._chain_after_sweep()
+
+    def _chain_after_sweep(self) -> None:
+        # Re-entrancy latch: a chained wave that somehow finishes
+        # synchronously (single-member trees) must not recurse through
+        # sweep->begin->sweep — the timer wake picks the tail up.
+        if self._chain_guard:
+            return
+        self._chain_guard = True
+        try:
+            self._maybe_begin_wave()
+        finally:
+            self._chain_guard = False
 
     def _own_stats(self, ws: _WaveState) -> dict:
         stats = {
@@ -1225,7 +1567,7 @@ class DistributedBookkeeper(Bookkeeper):
                 wire.encode_dprobe(msg.wave, msg.round_id, self._me, self.fence),
                 DProbe(msg.wave, msg.round_id, self._me, self.fence),
             )
-        self._flush_stat_report(ws)
+        self._pump(ws)
 
     def _on_dstat(self, msg: DStat) -> None:
         if msg.fence != self.fence:
@@ -1246,18 +1588,19 @@ class DistributedBookkeeper(Bookkeeper):
                 )
             return
         ws.child_stats.setdefault(msg.round_id, {})[msg.origin] = msg.stats
-        self._flush_stat_report(ws)
+        self._pump(ws)
 
     def _flush_stat_report(self, ws: _WaveState) -> None:
-        """Non-root: when every child's aggregate for the newest probed
-        round is in, fold our own stats and push the subtree aggregate
-        up the tree.  Work arriving after the report flips ``changed``,
-        which the NEXT round reports — the Safra lag the double-clean
-        rule at the root absorbs."""
+        """Non-root: once LOCALLY SETTLED with every child's aggregate
+        for the newest known round in, fold our own stats and push the
+        subtree aggregate up the tree.  Settle-gating is what lets the
+        root converge in ~2 rounds: a report always describes a locally
+        quiescent subtree, so the first judged round after global
+        quiescence is already quiet and the second confirms it."""
         if self.tree is None or self._is_root():
             return
         r = ws.probe_round_seen
-        if r <= ws.reported_round:
+        if r <= ws.reported_round or not ws.settled():
             return
         children = self.tree.children(self._me)
         got = ws.child_stats.get(r, {})
@@ -1275,62 +1618,97 @@ class DistributedBookkeeper(Bookkeeper):
             )
         ws.reported_round = r
 
-    def _root_termination(self, ws: _WaveState) -> None:
-        children = self.tree.children(self._me)
-        r = ws.probe_round
-        if r > 0 and not ws.round_done.get(r):
-            got = ws.child_stats.get(r, {})
-            if all(c in got for c in children):
-                agg = self._own_stats(ws)
-                for c in children:
-                    self._merge_stats(agg, got[c])
-                ws.round_done[r] = True
-                ws.rounds_run += 1
-                self.rounds_total += 1
-                events.recorder.commit(
-                    events.DIST_ROUND,
-                    wave=ws.wave,
-                    round=r,
-                    node=self._me,
-                    **{k: agg[k] for k in ("settled", "changed", "sent", "recv", "nodes")},
-                )
-                clean = (
-                    agg["settled"]
-                    and not agg["changed"]
-                    and agg["sent"] == agg["recv"]
-                    and agg["nodes"] == len(self.pmap.members)
-                )
-                ws.clean_rounds = ws.clean_rounds + 1 if clean else 0
-                if ws.clean_rounds >= 2:
-                    ws.fin = True
-                    for peer in self.remote_gcs:
-                        self._send_dist(
-                            peer,
-                            wire.encode_dfin(ws.wave, ws.fence, self._me),
-                            DFin(ws.wave, ws.fence, self._me),
-                        )
-                    return
-        if ws.round_done.get(r) or r == 0:
-            ws.probe_round = r + 1
-            r = ws.probe_round
-        # (Re-)probe the current round: a lost dprobe/dstat heals by
-        # the next wake's re-probe.
-        for child in children:
+    def _send_probe(self, ws: _WaveState) -> None:
+        for child in self.tree.children(self._me):
             self._send_dist(
                 child,
-                wire.encode_dprobe(ws.wave, r, self._me, self.fence),
-                DProbe(ws.wave, r, self._me, self.fence),
+                wire.encode_dprobe(
+                    ws.wave, ws.probe_round, self._me, self.fence
+                ),
+                DProbe(ws.wave, ws.probe_round, self._me, self.fence),
             )
-        if not children and not ws.round_done.get(r) and r > 0:
-            # Degenerate single-member tree: judge our own stats.
+
+    def _judge_round(self, ws: _WaveState, r: int, agg: dict) -> None:
+        """Judge one completed round at the root.  Termination: two
+        consecutive judged rounds whose aggregates are all-settled with
+        ``sent == recv`` AND an identical (sent, recv) signature.
+        Sound by the four-counter argument over idempotent cumulative
+        mark sets: during a wave the only sources of new local work are
+        received marks (recv grows) and the wave's own seeding, so
+        unchanged counters across two all-settled collections mean no
+        node did or can do anything between them — global fixpoint."""
+        ws.round_done[r] = True
+        ws.rounds_run += 1
+        self.rounds_total += 1
+        events.recorder.commit(
+            events.DIST_ROUND,
+            wave=ws.wave,
+            round=r,
+            node=self._me,
+            **{k: agg[k] for k in ("settled", "changed", "sent", "recv", "nodes")},
+        )
+        quiet = (
+            agg["settled"]
+            and agg["sent"] == agg["recv"]
+            and agg["nodes"] == len(self.pmap.members)
+        )
+        sig = (agg["sent"], agg["recv"])
+        # Single-round shortcut, sound ONLY at sent == recv == 0: a
+        # settled report means an empty queue, queues grow only by
+        # receiving marks, and receiving requires someone to have
+        # queued a send — zero global sends at every report time means
+        # none can ever occur.  (Nonzero totals genuinely need the
+        # second confirming round: a mark can circulate behind the
+        # report times and balance the counters by coincidence.)
+        if quiet and (sig == (0, 0) or ws.quiet_sig == sig):
+            ws.fin = True
+            for peer in self.remote_gcs:
+                self._send_dist(
+                    peer,
+                    wire.encode_dfin(ws.wave, ws.fence, self._me),
+                    DFin(ws.wave, ws.fence, self._me),
+                )
+            return
+        ws.quiet_sig = sig if quiet else None
+
+    def _root_termination(self, ws: _WaveState) -> None:
+        """Event-driven root judge: rounds open when the root itself is
+        settled, complete as reports arrive (piggybacked on dmacks or
+        explicit dstats), and the next round's probe goes out the
+        moment the previous one is judged — round latency is message
+        latency, with the per-wake dwave/dprobe re-sends as the
+        drop-healing fallback timer."""
+        if ws.fin or self.tree is None:
+            return
+        children = self.tree.children(self._me)
+        if ws.probe_round == 0:
+            if not ws.settled():
+                return
+            ws.probe_round = 1
+            self._send_probe(ws)
+        if not children:
+            # Degenerate single-member tree: judge our own stats; the
+            # second identical quiet round lands immediately.
+            for _ in range(2):
+                if ws.fin:
+                    break
+                r = ws.probe_round
+                self._judge_round(ws, r, self._own_stats(ws))
+                if not ws.fin:
+                    ws.probe_round = r + 1
+            return
+        while not ws.fin:
+            r = ws.probe_round
+            got = ws.child_stats.get(r, {})
+            if any(c not in got for c in children):
+                return  # waiting on reports; the wake re-probe heals
             agg = self._own_stats(ws)
-            ws.round_done[r] = True
-            ws.rounds_run += 1
-            self.rounds_total += 1
-            clean = agg["settled"] and not agg["changed"]
-            ws.clean_rounds = ws.clean_rounds + 1 if clean else 0
-            if ws.clean_rounds >= 2:
-                ws.fin = True
+            for c in children:
+                self._merge_stats(agg, got[c])
+            self._judge_round(ws, r, agg)
+            if not ws.fin:
+                ws.probe_round = r + 1
+                self._send_probe(ws)
 
     def _on_dfin(self, msg: DFin) -> None:
         if msg.fence > self.fence:
@@ -1348,6 +1726,7 @@ class DistributedBookkeeper(Bookkeeper):
         # it would supersede (and silently skip) this wave's sweep.
         n_garbage = self._sweep(ws)
         self._after_wake(n_garbage)
+        self._chain_after_sweep()
 
     # -- sweep ------------------------------------------------------- #
 
@@ -1415,6 +1794,13 @@ class DistributedBookkeeper(Bookkeeper):
                     continue
                 new_from.append(shadow)
             g.from_set = new_from
+            # Decayed mirrors follow the same hygiene: once no owned
+            # edge references one, its shadow_map pin goes too.
+            for cell in [
+                c for c, s in g.evicted.items() if s not in referenced
+            ]:
+                g.evicted.pop(cell, None)
+                g.shadow_map.pop(cell, None)
             dispatch_kills(kills)
             # Count only actors actually removed this wave: a
             # gate-pending child stays in the graph for the dgate retry
@@ -1477,6 +1863,7 @@ class DistributedBookkeeper(Bookkeeper):
         # With the wave closed and every deferred fold landed, the
         # retained journals can be judged against graph state.
         self._compact_retained()
+        g.decay_mirrors(self.mirror_decay)
         return n_garbage
 
     def _compact_retained(self) -> None:
@@ -1611,5 +1998,7 @@ class DistributedBookkeeper(Bookkeeper):
             "owned_population": g.owned_population(),
             "population": len(g.from_set),
             "boundary_edges": g.boundary_edges,
+            "mirrors_evicted": len(g.evicted),
+            "mirrors_evicted_total": g.mirrors_evicted_total,
         }
         return out

@@ -42,6 +42,7 @@ class Shadow:
         "is_busy",
         "is_halted",
         "partition",
+        "touch_tick",
     )
 
     def __init__(self) -> None:
@@ -50,6 +51,9 @@ class Shadow:
         #: cross-node partition id memo (parallel/partition.py) — pure
         #: in the cell's (address, uid), so computed once per shadow
         self.partition: Optional[int] = None
+        #: mirror-decay clock (distributed mode): the graph's decay
+        #: tick when a fold last mentioned this shadow
+        self.touch_tick = 0
         #: net created-minus-deactivated refs toward each target; may be
         #: negative (reference: Shadow.java:14-19)
         self.outgoing: Dict["Shadow", int] = {}

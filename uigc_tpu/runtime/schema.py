@@ -226,6 +226,171 @@ class SchemaRegistry:
 SCHEMA_VAL = 1  # a bare value-plane message (unmanaged/raw sends)
 SCHEMA_CRGC_APP = 2  # CRGC AppMsg envelope
 SCHEMA_MAC_APP = 3  # MAC MacAppMsg envelope
+SCHEMA_DIST_KEYS = 4  # distributed-collector boundary-mark key sets
+
+
+# ------------------------------------------------------------------- #
+# Key-set codec (the distributed collector's dmark payload plane)
+#
+# A boundary-mark set is a set of (address, uid) actor coordinates.
+# PR 14 shipped them as JSON ``[[address, uid], ...]`` lists — ~29
+# bytes per key on the wire.  This codec groups keys per address and
+# encodes each group's uid set density-switched:
+#
+#   payload := 0x01 varint(n_groups) group*
+#   group   := varint(len(addr)) addr 'B' varint(base) varint(span)
+#              varint(len(bits)) bits                        (bitmap)
+#            | varint(len(addr)) addr 'V' varint(n)
+#              varint(first) varint(delta)*                  (varint)
+#
+# The bitmap form wins for dense uid ranges (one BIT per key); the
+# delta-varint form wins for sparse sets (~1-2 bytes per key).  The
+# switch is deterministic: bitmap iff its byte size is smaller than
+# the group's key count (the varint form's lower bound).  The leading
+# 0x01 byte can never begin a JSON list (b"["), so a decoder can
+# dispatch legacy JSON and this format from the first byte
+# (:func:`decode_keyset_any`) — the mixed-version story: a PR-14 peer's
+# JSON payload still decodes, and this format is only ever SENT to a
+# peer whose hello advertised :data:`SCHEMA_DIST_KEYS`.
+# ------------------------------------------------------------------- #
+
+KEYSET_MAGIC = 0x01
+
+
+def _put_varint(parts: List[bytes], value: int) -> None:
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    parts.append(bytes(out))
+
+
+def _get_varint(data: bytes, off: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = data[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def encode_keyset(keys: Iterable[Tuple[str, int]]) -> bytes:
+    """Binary key-set payload (see the format block above)."""
+    groups: Dict[str, List[int]] = {}
+    for address, uid in keys:
+        groups.setdefault(address, []).append(int(uid))
+    parts: List[bytes] = [bytes([KEYSET_MAGIC])]
+    _put_varint(parts, len(groups))
+    for address in sorted(groups):
+        uids = sorted(set(groups[address]))
+        addr = address.encode()
+        _put_varint(parts, len(addr))
+        parts.append(addr)
+        base, last = uids[0], uids[-1]
+        span = last - base + 1
+        bitmap_bytes = (span + 7) // 8
+        if bitmap_bytes < len(uids):
+            bits = 0
+            for uid in uids:
+                bits |= 1 << (uid - base)
+            raw = bits.to_bytes(bitmap_bytes, "little")
+            parts.append(b"B")
+            _put_varint(parts, base)
+            _put_varint(parts, span)
+            _put_varint(parts, len(raw))
+            parts.append(raw)
+        else:
+            parts.append(b"V")
+            _put_varint(parts, len(uids))
+            prev = 0
+            for uid in uids:
+                _put_varint(parts, uid - prev)
+                prev = uid
+    return b"".join(parts)
+
+
+def decode_keyset(data: bytes) -> Optional[List[Tuple[str, int]]]:
+    """-> [(address, uid), ...] or None when malformed."""
+    try:
+        if not data or data[0] != KEYSET_MAGIC:
+            return None
+        keys: List[Tuple[str, int]] = []
+        n_groups, off = _get_varint(data, 1)
+        for _ in range(n_groups):
+            alen, off = _get_varint(data, off)
+            address = data[off : off + alen].decode()
+            if len(address.encode()) != alen:
+                return None
+            off += alen
+            mode = data[off : off + 1]
+            off += 1
+            if mode == b"B":
+                base, off = _get_varint(data, off)
+                span, off = _get_varint(data, off)
+                blen, off = _get_varint(data, off)
+                raw = data[off : off + blen]
+                if len(raw) != blen:
+                    return None
+                off += blen
+                bits = int.from_bytes(raw, "little")
+                if bits >> span:
+                    return None
+                while bits:
+                    low = bits & -bits
+                    keys.append((address, base + low.bit_length() - 1))
+                    bits ^= low
+            elif mode == b"V":
+                count, off = _get_varint(data, off)
+                uid = 0
+                for _ in range(count):
+                    delta, off = _get_varint(data, off)
+                    uid += delta
+                    keys.append((address, uid))
+            else:
+                return None
+        return keys
+    except (IndexError, UnicodeDecodeError, OverflowError):
+        return None
+
+
+def encode_keyset_json(keys: Iterable[Tuple[str, int]]) -> bytes:
+    """The PR-14 wire shape, kept as the legacy-peer fallback: only a
+    peer whose hello advertised :data:`SCHEMA_DIST_KEYS` receives the
+    binary form."""
+    import json
+
+    return json.dumps([[a, int(u)] for a, u in keys]).encode()
+
+
+def decode_keyset_any(data: bytes) -> Optional[List[Tuple[str, int]]]:
+    """Dispatch on the first byte: binary key-set or legacy JSON
+    coordinate list — tolerant both directions, None when neither."""
+    if not isinstance(data, bytes) or not data:
+        return None
+    if data[0] == KEYSET_MAGIC:
+        return decode_keyset(data)
+    import json
+
+    try:
+        raw = json.loads(data)
+    except ValueError:
+        return None
+    if not isinstance(raw, list):
+        return None
+    keys = []
+    for item in raw:
+        try:
+            keys.append((str(item[0]), int(item[1])))
+        except (IndexError, TypeError, ValueError):
+            return None
+    return keys
 
 _APP_HDR = struct.Struct(">qBH")  # (window_id, flags, n_refs)
 
@@ -401,6 +566,24 @@ def _vec_decode_mac_app(fabric: "Fabric", body: bytes) -> List[Any]:
     ]
 
 
+def _probe_keyset(msg: Any) -> bool:
+    return type(msg) is list
+
+
+def _encode_keyset_msg(msg: Any) -> Optional[bytes]:
+    try:
+        return encode_keyset(msg)
+    except (TypeError, ValueError, AttributeError):
+        return None
+
+
+def _decode_keyset_msg(fabric: "Fabric", body: bytes) -> Any:
+    keys = decode_keyset(body)
+    if keys is None:
+        raise ValueError("malformed key-set body")
+    return keys
+
+
 def _build_default_registry() -> SchemaRegistry:
     registry = SchemaRegistry()
     registry.register(
@@ -412,6 +595,21 @@ def _build_default_registry() -> SchemaRegistry:
             _decode_val,
             _vec_encode_val,
             _vec_decode_val,
+        )
+    )
+    # The key-set codec has no envelope type (it is a frame PAYLOAD
+    # codec, not a message schema): registering it by id makes the
+    # hello caps advertise it, which is how the distributed collector
+    # learns a peer can decode binary dmark payloads (wire.py).
+    registry.register(
+        Schema(
+            SCHEMA_DIST_KEYS,
+            "dist-keys",
+            _probe_keyset,
+            _encode_keyset_msg,
+            _decode_keyset_msg,
+            _encode_keyset_msg,
+            _decode_keyset_msg,
         )
     )
     registry.register(

@@ -530,22 +530,35 @@ def decode_entity_frame(frame: tuple):
 
 
 def encode_migration_frame(
-    type_name: str, key: str, mig_id: tuple, blob: bytes, fence: int = 0
+    type_name: str,
+    key: str,
+    mig_id: tuple,
+    blob: bytes,
+    fence: int = 0,
+    epoch: int = 0,
 ) -> tuple:
     """Handoff state transfer: ``blob`` is the encode_message bytes of a
     ``(snapshot, pending_payloads)`` pair.  Fence-stamped at SEND time:
     a receiver refuses state shipped under a superseded partition era
-    (a stale owner's post-partition copy) instead of merging it."""
-    return ("mig", type_name, key, tuple(mig_id), blob, int(fence))
+    (a stale owner's post-partition copy) instead of merging it.
+    ``epoch`` (trailing, tolerant) is the SOURCE's journal epoch for the
+    shipped state: the destination's activation opens strictly past it,
+    so a same-millisecond handoff with a stale destination scan can
+    never let the source's capture record supersede the destination's
+    later acked commands in a recovery merge."""
+    return ("mig", type_name, key, tuple(mig_id), blob, int(fence), int(epoch))
 
 
 def decode_migration_frame(frame: tuple):
-    """-> (type_name, key, mig_id, blob, fence) or None."""
+    """-> (type_name, key, mig_id, blob, fence, epoch) or None."""
     try:
         type_name, key, mig_id, blob = frame[1], frame[2], frame[3], frame[4]
         if not isinstance(blob, bytes) or not isinstance(mig_id, tuple):
             return None
-        return str(type_name), str(key), mig_id, blob, _frame_fence(frame, 5)
+        return (
+            str(type_name), str(key), mig_id, blob,
+            _frame_fence(frame, 5), _frame_fence(frame, 6),
+        )
     except (IndexError, TypeError, ValueError):
         return None
 
@@ -743,16 +756,20 @@ def decode_ts_response(frame: tuple):
 # Distributed-collector frames (engines/crgc/distributed.py)
 #
 # The cross-node trace-wave protocol: boundary marks ("dmark") routed
-# point-to-point to the partition owner, cumulative-set acks ("dmack"),
+# point-to-point to the partition owner, watermark acks ("dmack"),
 # wave control ("dwave"/"dfin"), Safra-style termination rounds over
-# the reduction tree ("dprobe"/"dstat"), the remote supervisor kill
-# gate ("dgate"/"dgack"), and the root dirty hint ("ddirty").  Same
-# tolerance contract as every subsystem frame family above: trailing
-# elements accepted, malformed -> None, unknown kinds ignored by old
-# peers after seq accounting.  Actor coordinates cross as JSON
-# ``[address, uid]`` pairs — data, never pickle — and re-bind through
+# the reduction tree ("dprobe"/"dstat" — the explicit fallback; the
+# round stamp and leaf reports normally PIGGYBACK on dwave/dmark/dmack
+# trailing elements), the remote supervisor kill gate ("dgate"/
+# "dgack"), and the root dirty hint ("ddirty").  Same tolerance
+# contract as every subsystem frame family above: trailing elements
+# accepted, malformed -> None, unknown kinds ignored by old peers
+# after seq accounting.  Mark payloads are density-switched binary
+# key sets (runtime/schema.py encode_keyset, negotiated via the
+# schema-codec hello caps) with the PR-14 JSON coordinate list as the
+# legacy fallback — data, never pickle; coordinates re-bind through
 # ``resolve_cell_token`` at the receiver, so a frame from a newer peer
-# can at worst fail json.loads.
+# can at worst fail the payload decode.
 # ------------------------------------------------------------------- #
 
 DIST_FRAME_KINDS = (
@@ -779,51 +796,88 @@ def decode_djournal(frame: tuple):
         return None
 
 
-def _keys_payload(keys) -> bytes:
-    return json.dumps([[a, int(u)] for a, u in keys]).encode()
+def _keys_payload(keys, binary: bool) -> bytes:
+    # Payload construction delegates to the schema-codec helpers (the
+    # UL015 contract): binary toward peers whose hello advertised
+    # SCHEMA_DIST_KEYS, the PR-14 JSON coordinate list otherwise.
+    schema = _schema_mod()
+    if binary:
+        return schema.encode_keyset(keys)
+    return schema.encode_keyset_json(keys)
 
 
 def _decode_keys(payload):
     if not isinstance(payload, bytes):
         return None
+    return _schema_mod().decode_keyset_any(payload)
+
+
+def _frame_report(frame: tuple, index: int):
+    """Tolerant read of a piggybacked termination report: a 5-sequence
+    of ints ``(settled, changed, sent, recv, nodes)`` or None.
+    Anything unrecognizable decodes as absent, never an error."""
     try:
-        raw = json.loads(payload)
-    except ValueError:
+        raw = frame[index]
+    except IndexError:
         return None
-    if not isinstance(raw, list):
+    if not isinstance(raw, (tuple, list)) or len(raw) < 5:
         return None
-    keys = []
-    for item in raw:
-        try:
-            keys.append((str(item[0]), int(item[1])))
-        except (IndexError, TypeError, ValueError):
-            return None
-    return keys
+    try:
+        return tuple(int(v) for v in raw[:5])
+    except (TypeError, ValueError):
+        return None
 
 
-def encode_dwave(wave: int, fence: int, origin: str) -> tuple:
-    return ("dwave", int(wave), int(fence), origin)
+def encode_dwave(wave: int, fence: int, origin: str, round_id: int = 0) -> tuple:
+    """Wave announcement; the trailing round stamp is the root's
+    current termination round riding the data plane (a PR-14 peer
+    ignores it; absent decodes as round 0 = 'none disseminated')."""
+    return ("dwave", int(wave), int(fence), origin, int(round_id))
 
 
 def decode_dwave(frame: tuple):
-    """-> (wave, fence, origin) or None."""
+    """-> (wave, fence, origin, round_id) or None."""
     try:
-        return int(frame[1]), int(frame[2]), str(frame[3])
+        return (
+            int(frame[1]), int(frame[2]), str(frame[3]),
+            _frame_fence(frame, 4),
+        )
     except (IndexError, TypeError, ValueError):
         return None
 
 
-def encode_dmark(wave: int, fence: int, origin: str, keys) -> tuple:
-    return ("dmark", int(wave), int(fence), origin, _keys_payload(keys))
+def encode_dmark(
+    wave: int,
+    fence: int,
+    origin: str,
+    keys,
+    start: int = 0,
+    binary: bool = True,
+    round_id: int = 0,
+) -> tuple:
+    """Boundary marks.  ``start`` is the position of ``keys[0]`` in the
+    sender's cumulative per-peer mark list — the suffix-flush protocol:
+    each flush carries only keys past the receiver's acked watermark
+    (a PR-14 frame has no element 5 and decodes as start 0, i.e. the
+    old full-cumulative shape).  ``round_id`` disseminates the
+    termination round epidemic-style."""
+    return (
+        "dmark", int(wave), int(fence), origin,
+        _keys_payload(keys, binary), int(start), int(round_id),
+    )
 
 
 def decode_dmark(frame: tuple):
-    """-> (wave, fence, origin, [(address, uid), ...]) or None."""
+    """-> (wave, fence, origin, [(address, uid), ...], start, round_id)
+    or None."""
     try:
         keys = _decode_keys(frame[4])
         if keys is None:
             return None
-        return int(frame[1]), int(frame[2]), str(frame[3]), keys
+        return (
+            int(frame[1]), int(frame[2]), str(frame[3]), keys,
+            _frame_fence(frame, 5), _frame_fence(frame, 6),
+        )
     except (IndexError, TypeError, ValueError):
         return None
 
@@ -839,16 +893,36 @@ def _frame_fence(frame: tuple, index: int) -> int:
         return 0
 
 
-def encode_dmack(wave: int, origin: str, count: int, fence: int = 0) -> tuple:
-    return ("dmack", int(wave), origin, int(count), int(fence))
+def encode_dmack(
+    wave: int,
+    origin: str,
+    count: int,
+    fence: int = 0,
+    round_id: int = 0,
+    report=None,
+) -> tuple:
+    """Mark ack.  ``count`` is the receiver's CONTIGUOUS coverage
+    watermark over the sender's mark list (identical to the old
+    cumulative distinct count under full-list sends, so PR-14 senders
+    read it unchanged).  ``round_id`` disseminates the termination
+    round; ``report`` optionally piggybacks the acker's settled
+    termination report ``(settled, changed, sent, recv, nodes)`` for
+    that round — how leaf reports ride the data plane instead of
+    explicit dstat frames."""
+    return (
+        "dmack", int(wave), origin, int(count), int(fence),
+        int(round_id),
+        tuple(int(v) for v in report) if report is not None else None,
+    )
 
 
 def decode_dmack(frame: tuple):
-    """-> (wave, origin, count, fence) or None."""
+    """-> (wave, origin, count, fence, round_id, report) or None."""
     try:
         return (
             int(frame[1]), str(frame[2]), int(frame[3]),
-            _frame_fence(frame, 4),
+            _frame_fence(frame, 4), _frame_fence(frame, 5),
+            _frame_report(frame, 6),
         )
     except (IndexError, TypeError, ValueError):
         return None

@@ -589,6 +589,17 @@ class EventMetricsBridge:
             "Partition journals re-folded after an ownership transfer "
             "(the absorb-on-death path), by partition owner change.",
         )
+        self._dist_mark_bytes = r.counter(
+            "uigc_dist_mark_bytes_total",
+            "Encoded dmark payload bytes shipped between partition "
+            "owners (density-switched key-set codec; suffix flushes "
+            "plus retransmits), by dst.",
+        )
+        self._dist_mirror_evictions = r.counter(
+            "uigc_dist_mirror_evictions_total",
+            "Foreign-owned boundary mirrors decayed out of the "
+            "traversal working set (uigc.crgc.mirror-decay-waves).",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -729,6 +740,11 @@ class EventMetricsBridge:
             self._dist_marks.inc(
                 fields.get("count", 1) or 1, dst=fields.get("dst", "?")
             )
+            nbytes = fields.get("bytes")
+            if nbytes:
+                self._dist_mark_bytes.inc(nbytes, dst=fields.get("dst", "?"))
+        elif name == events.DIST_MIRROR_EVICT:
+            self._dist_mirror_evictions.inc(fields.get("count", 1) or 1)
         elif name == events.DIST_WAVE:
             edges = fields.get("boundary_edges")
             if edges is not None:

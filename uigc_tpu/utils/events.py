@@ -184,6 +184,10 @@ DIST_MARKS = "crgc.dist_marks"
 DIST_ROUND = "crgc.dist_round"
 DIST_REFOLD = "crgc.dist_refold"
 DIST_LOCALITY = "crgc.dist_locality_violation"
+#: mirror decay (fields: count, resident, node) — foreign-owned
+#: shadows left the traversal working set after the configured number
+#: of untouched waves (uigc.crgc.mirror-decay-waves)
+DIST_MIRROR_EVICT = "crgc.dist_mirror_evict"
 
 # Cluster-sharding events (ours; uigc_tpu/cluster).  Emitted by the
 # shard regions and the migration machinery so rebalances are observable
