@@ -295,6 +295,40 @@ def test_cluster_frame_round_trip_property():
     assert header == (123, 456)
 
 
+def test_trace_header_and_shard_leave_decoders_tolerate_malformed_input():
+    """Pin the malformed-input (-> None) tolerance contract of the two
+    decoders the round-trip property test does not reach: a received
+    trace header and the voluntary-departure frame.  Surfaced by
+    uigc-check (UC105): both decoders promise None-never-raise but had
+    no test reference pinning it."""
+    # decode_trace_header: anything that is not a (trace_id, span_id)
+    # pair of non-negative ints is absent, never an error.
+    assert wire.decode_trace_header(None) is None
+    assert wire.decode_trace_header((123, 456)) == (123, 456)
+    for junk in (
+        "not-a-header",
+        (1,),
+        (1, 2, 3),
+        (-1, 2),
+        (1, -2),
+        ("1", 2),
+        (1.0, 2),
+        [1, 2],
+        {"trace": 1},
+        b"\x00\x01",
+    ):
+        assert wire.decode_trace_header(junk) is None
+    # decode_shard_leave: origin round-trips; a frame whose origin slot
+    # is missing or not a string decodes to None.
+    assert wire.decode_shard_leave(wire.encode_shard_leave("uigc://a")) == (
+        "uigc://a"
+    )
+    # Trailing elements from a newer peer are tolerated.
+    assert wire.decode_shard_leave(("sleave", "uigc://a", "extra")) == "uigc://a"
+    for junk in (("sleave",), ("sleave", 7), ("sleave", None), ("sleave", b"a")):
+        assert wire.decode_shard_leave(junk) is None
+
+
 def test_unknown_frame_kind_neither_crashes_nor_desyncs(event_log):
     """An old-version peer receiving an unknown frame kind must ignore
     it AND keep its sequence numbers in step: the frames after it are

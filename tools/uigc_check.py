@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""uigc-check: whole-repo cross-plane static analysis — CLI shim.
+
+The analyzer lives in ``uigc_tpu/analysis/check/`` (shared single
+parse; lint + surface-registry + lock-graph + trace-purity passes);
+this script only puts the repo root on ``sys.path`` and dispatches.
+
+    python tools/uigc_check.py --strict uigc_tpu/ tools/
+
+See ``uigc_tpu/analysis/check/cli.py`` for flags, GUIDE.md
+("Correctness tooling") for the two-layer story, and PROFILING.md
+("Reading uigc_check") for a worked finding.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from uigc_tpu.analysis.check import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,38 @@
+"""uigc-check: whole-repo cross-plane static analysis.
+
+The static half of the correctness tooling (the online half is uigcsan,
+``uigc_tpu/analysis/sanitizer.py``).  One shared parse of the analyzed
+tree feeds four passes:
+
+``lint``     the UL001-UL015 file-local rules uigc-lint established
+             (:mod:`.lint_rules`; ``tools/uigc_lint.py`` is now a thin
+             wrapper over this pass)
+``surface``  the cross-plane surface registry: config keys, event
+             names, metric names, NodeFabric frame kinds and schema
+             ids harvested into one machine-readable document, with
+             UC1xx rules over the seams between them (:mod:`.surface`)
+``locks``    the interprocedural lock-order graph: per-class lock
+             identities, ``with``-acquisitions connected through a
+             call graph, cycle witnesses and blocking-call-under-lock
+             (:mod:`.locks`)
+``purity``   trace purity: functions reachable from ``jax.jit`` /
+             Pallas entry points must not mutate Python state, call
+             RNG/time, or read back off-device unannotated; plus jit
+             recompile hazards (:mod:`.purity`)
+
+Every pass consumes the same :class:`~.core.ParsedFile` list (one
+``ast.parse`` per file, ever), reports through the same structured
+:class:`~.core.Diagnostic`, honors the same ``# uigc-lint:
+disable=RULE`` suppression comments, and shares the one allowlist
+budget file.  ``tools/uigc_check.py`` is the CLI.
+"""
+
+from .core import (  # noqa: F401
+    Diagnostic,
+    ParsedFile,
+    apply_allowlist,
+    iter_py_files,
+    load_allowlist,
+    parse_paths,
+)
+from .cli import run_check, main  # noqa: F401

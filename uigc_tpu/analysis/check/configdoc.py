@@ -1,0 +1,77 @@
+"""CONFIG.md: the generated config-surface reference.
+
+Rendered deterministically from the surface registry so it can never
+drift silently: the surface pass re-renders on every run and raises
+UC106 when the committed file differs.  Regenerate with::
+
+    python tools/uigc_check.py --write-config uigc_tpu/ tools/
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_HEADER = """\
+# Configuration reference
+
+<!-- GENERATED FILE — do not edit by hand.
+     Rendered by `python tools/uigc_check.py --write-config uigc_tpu/ tools/`
+     from the surface registry; `uigc_check --strict` fails on drift (UC106). -->
+
+Every key is read through `uigc_tpu.config.Config` (`get`, `get_int`,
+`get_bool`, `get_float`, `get_string`) and defaults live in the
+`DEFAULTS` dict in `uigc_tpu/config.py`. The *read by* column names the
+first module that reads the key; see GUIDE.md for the narrative
+documentation of each subsystem's knobs.
+
+| key | default | read by | doc |
+| --- | --- | --- | --- |
+"""
+
+
+def _fmt_default(value: Any) -> str:
+    if isinstance(value, str):
+        return f'`"{value}"`'
+    return f"`{value!r}`"
+
+
+def _reader_module(sites: list) -> str:
+    if not sites:
+        return "—"
+    first = sites[0]
+    path = first.rsplit(":", 1)[0]
+    # uigc_tpu/runtime/node.py -> runtime/node (the sites may carry an
+    # absolute prefix when the CLI was handed absolute paths; the
+    # rendered document must not depend on the spelling).
+    path = path.replace(os.sep, "/")
+    marker = "uigc_tpu/"
+    idx = path.rfind(marker)
+    if idx >= 0:
+        path = path[idx + len(marker):]
+    if path.endswith(".py"):
+        path = path[: -len(".py")]
+    extra = len(sites) - 1
+    return f"`{path}`" + (f" (+{extra})" if extra else "")
+
+
+def _escape(text: str) -> str:
+    return text.replace("|", "\\|")
+
+
+def render_config_md(registry: Dict[str, Any]) -> str:
+    rows = []
+    for key in sorted(registry.get("config", {})):
+        info = registry["config"][key]
+        if not info.get("in_defaults"):
+            continue  # typo-class keys are diagnostics, not documentation
+        doc = info.get("doc") or ""
+        rows.append(
+            "| `{key}` | {default} | {reader} | {doc} |".format(
+                key=key,
+                default=_fmt_default(info.get("default")),
+                reader=_reader_module(info.get("readers", [])),
+                doc=_escape(doc),
+            )
+        )
+    return _HEADER + "\n".join(rows) + "\n"

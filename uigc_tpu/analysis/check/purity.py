@@ -1,0 +1,538 @@
+"""Trace-purity pass.
+
+UL011 flags host-transfer idioms by *module directory* — every
+``.item()`` under ``ops/`` looks the same to it, whether or not the
+enclosing function is ever traced.  This pass knows which functions
+actually run under a tracer: it discovers ``jax.jit`` / Pallas /
+``shard_map`` entry points in ``ops/``, ``parallel/`` and
+``engines/crgc/``, closes them over the call graph, and only then
+applies the purity rules — so a host-side helper that happens to live
+in ``ops/`` is no longer collateral, and a traced function calling
+into an impure helper two modules away *is* caught.
+
+UC301  a traced-reachable function mutates Python state visible
+       outside the trace (``global``/``nonlocal`` rebinding, or
+       mutation of a module-level container) — the mutation runs once
+       at trace time, then never again
+UC302  a traced-reachable function calls host RNG or wall-clock time
+       (``random.*``, ``np.random.*``, ``time.*``, ``datetime.*``) —
+       the value freezes into the compiled program; ``jax.random`` is
+       the keyed, traceable alternative and is exempt
+UC303  a traced-reachable function reads back off-device
+       (``jax.device_get``, zero-arg ``.item()``, dtype-less
+       ``np.asarray``) without a ``# readback: <why>`` annotation —
+       the reachability-aware refinement of UL011
+UC304  recompile hazard at a jit call site: jitting a lambda or
+       locally-defined function inside another function (a fresh
+       callable object per call — the cache never hits), or passing
+       an unhashable literal (list/dict/set) in a static-argument
+       position of a known jitted callable
+
+Entry-point discovery covers decorator forms (``@jax.jit``,
+``@partial(jax.jit, ...)``), wrapper-call forms (``f = jax.jit(g)``,
+``pl.pallas_call(kernel, ...)``), and ``shard_map``/``pmap``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Diagnostic, ParsedFile, call_name, dotted_name
+
+RULES = {
+    "UC301": "traced function mutates Python state",
+    "UC302": "traced function calls host RNG or wall-clock time",
+    "UC303": "traced function reads back off-device without '# readback:'",
+    "UC304": "jit recompile hazard (per-call callable or unhashable static arg)",
+}
+
+_TRACERS = {"jit", "pallas_call", "shard_map", "pmap", "checkpoint"}
+_NUMPY_QUALS = {"np", "numpy", "jnp"}
+_DEVICE_DIRS = ("/ops/", "/parallel/", "/engines/crgc/")
+_RNG_TIME = re.compile(
+    r"^(random|numpy\.random|np\.random|time|datetime(\.datetime)?)\."
+)
+_CONTAINER_MUTATORS = {
+    "append",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "extend",
+    "insert",
+    "clear",
+    "remove",
+}
+_MAX_DEPTH = 8
+
+
+def _is_device_module(pf: ParsedFile) -> bool:
+    return any(d in pf.norm for d in _DEVICE_DIRS)
+
+
+def _walk_shallow(fn: ast.AST):
+    """Walk a function body without descending into nested defs — those
+    are separate functions with their own reachability entries."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _tracer_call(node: ast.Call) -> Optional[str]:
+    """'jit' / 'pallas_call' / ... when this Call invokes a tracer."""
+    dn = dotted_name(node.func)
+    if dn is None:
+        return None
+    last = dn.split(".")[-1]
+    if last not in _TRACERS:
+        return None
+    # jax.jit / jit / pl.pallas_call / jax.experimental.shard_map.shard_map
+    return last
+
+
+class FuncEntry:
+    __slots__ = ("qual", "pf", "node", "cls")
+
+    def __init__(
+        self, qual: str, pf: ParsedFile, node: ast.AST, cls: Optional[str]
+    ):
+        self.qual = qual
+        self.pf = pf
+        self.node = node
+        self.cls = cls
+
+
+class PurityPass:
+    def __init__(self, files: List[ParsedFile]):
+        self.files = [pf for pf in files if not pf.in_tests]
+        self.funcs: Dict[str, FuncEntry] = {}
+        self.module_funcs: Dict[str, Dict[str, str]] = defaultdict(dict)
+        self.class_methods: Dict[str, Dict[str, str]] = defaultdict(dict)
+        self.method_index: Dict[str, Set[str]] = defaultdict(set)
+        self.module_globals: Dict[str, Set[str]] = defaultdict(set)
+        # module-level jitted names with literal static positions:
+        # (module norm, name) -> set of static argument indices
+        self.static_positions: Dict[Tuple[str, str], Set[int]] = {}
+        self.entries: List[Tuple[str, str]] = []  # (qual, how)
+        self.diagnostics: List[Diagnostic] = []
+
+    # ---- indexes ---------------------------------------------------- #
+
+    def build(self) -> None:
+        for pf in self.files:
+            for node in pf.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{pf.norm}:{node.name}"
+                    self.module_funcs[pf.norm][node.name] = qual
+                    self.funcs[qual] = FuncEntry(qual, pf, node, None)
+                    # nested defs
+                    self._index_nested(pf, node, qual, None)
+                elif isinstance(node, ast.ClassDef):
+                    for item in node.body:
+                        if isinstance(
+                            item, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            qual = f"{pf.norm}:{node.name}.{item.name}"
+                            self.class_methods[node.name][item.name] = qual
+                            self.method_index[item.name].add(qual)
+                            self.funcs[qual] = FuncEntry(
+                                qual, pf, item, node.name
+                            )
+                            self._index_nested(pf, item, qual, node.name)
+                elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for target in targets:
+                        if isinstance(target, ast.Name):
+                            self.module_globals[pf.norm].add(target.id)
+
+    def _index_nested(
+        self,
+        pf: ParsedFile,
+        fn: ast.AST,
+        parent_qual: str,
+        cls: Optional[str],
+    ) -> None:
+        for sub in ast.walk(fn):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not fn
+            ):
+                qual = f"{parent_qual}.<{sub.name}>"
+                self.funcs.setdefault(qual, FuncEntry(qual, pf, sub, cls))
+
+    # ---- entry-point discovery -------------------------------------- #
+
+    def find_entries(self) -> None:
+        for pf in self.files:
+            if not _is_device_module(pf):
+                continue
+            # Decorator forms on module/class functions.
+            for qual, entry in list(self.funcs.items()):
+                if entry.pf is not pf:
+                    continue
+                node = entry.node
+                for dec in getattr(node, "decorator_list", []):
+                    how = self._decorator_tracer(dec)
+                    if how is not None:
+                        self.entries.append((qual, how))
+            # Wrapper-call forms anywhere in the module.
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                tracer = _tracer_call(node)
+                if tracer is None:
+                    continue
+                for target_qual in self._traced_operands(pf, node):
+                    self.entries.append((target_qual, tracer))
+                self._note_static_positions(pf, node)
+
+    def _decorator_tracer(self, dec: ast.AST) -> Optional[str]:
+        dn = dotted_name(dec)
+        if dn is not None and dn.split(".")[-1] in _TRACERS:
+            return dn.split(".")[-1]
+        if isinstance(dec, ast.Call):
+            tracer = _tracer_call(dec)
+            if tracer is not None:
+                return tracer
+            # @partial(jax.jit, ...)
+            if call_name(dec)[1] == "partial" and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner is not None and inner.split(".")[-1] in _TRACERS:
+                    return inner.split(".")[-1]
+        return None
+
+    def _traced_operands(
+        self, pf: ParsedFile, call: ast.Call
+    ) -> List[str]:
+        """Resolve `jax.jit(f)` / `pallas_call(kernel, ...)` operands to
+        known function qualnames in the same module."""
+        out: List[str] = []
+        operands = list(call.args[:1])
+        for kw in call.keywords:
+            if kw.arg in ("fun", "f", "kernel"):
+                operands.append(kw.value)
+        for op in operands:
+            if isinstance(op, ast.Name):
+                qual = self.module_funcs.get(pf.norm, {}).get(op.id)
+                if qual is not None:
+                    out.append(qual)
+                else:
+                    # nested def in the enclosing function
+                    for q, entry in self.funcs.items():
+                        if entry.pf is pf and q.endswith(f".<{op.id}>"):
+                            out.append(q)
+        return out
+
+    def _note_static_positions(self, pf: ParsedFile, call: ast.Call) -> None:
+        """Record `f = jax.jit(g, static_argnums=(1,))` so later calls
+        to f can be checked for unhashable literals at static slots."""
+        positions: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                try:
+                    value = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                if isinstance(value, int):
+                    positions.add(value)
+                elif isinstance(value, (tuple, list)):
+                    positions.update(v for v in value if isinstance(v, int))
+        if not positions:
+            return
+        # Find the Assign this call is the value of (module level only).
+        for node in pf.tree.body:
+            if isinstance(node, ast.Assign) and node.value is call:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.static_positions[(pf.norm, target.id)] = positions
+
+    # ---- reachability ----------------------------------------------- #
+
+    def _resolve_callee(
+        self, entry: FuncEntry, call: ast.Call
+    ) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            qual = self.module_funcs.get(entry.pf.norm, {}).get(fn.id)
+            if qual is not None:
+                return qual
+            # nested def captured by name inside the same parent
+            nested = f"{entry.qual}.<{fn.id}>"
+            if nested in self.funcs:
+                return nested
+            return None
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if (
+                isinstance(base, ast.Name)
+                and base.id == "self"
+                and entry.cls is not None
+            ):
+                return self.class_methods.get(entry.cls, {}).get(fn.attr)
+            candidates = self.method_index.get(fn.attr, set())
+            if len(candidates) == 1:
+                return next(iter(candidates))
+        return None
+
+    def reachable(self) -> Dict[str, Tuple[str, ...]]:
+        """qual -> witness chain of quals from an entry point."""
+        seen: Dict[str, Tuple[str, ...]] = {}
+        work: List[Tuple[str, Tuple[str, ...]]] = []
+        for qual, _how in self.entries:
+            if qual not in seen:
+                seen[qual] = (qual,)
+                work.append((qual, (qual,)))
+        while work:
+            qual, chain = work.pop()
+            if len(chain) >= _MAX_DEPTH:
+                continue
+            entry = self.funcs.get(qual)
+            if entry is None:
+                continue
+            for node in ast.walk(entry.node):
+                if isinstance(node, ast.Call):
+                    callee = self._resolve_callee(entry, node)
+                    if callee is not None and callee not in seen:
+                        seen[callee] = chain + (callee,)
+                        work.append((callee, chain + (callee,)))
+        return seen
+
+    # ---- the rules --------------------------------------------------- #
+
+    def check(self) -> None:
+        reach = self.reachable()
+
+        def add(
+            pf: ParsedFile, line: int, rule: str, message: str
+        ) -> None:
+            if pf.suppressed_on(line, rule):
+                return
+            self.diagnostics.append(Diagnostic(pf.path, line, rule, message))
+
+        def via(chain: Tuple[str, ...]) -> str:
+            if len(chain) <= 1:
+                return ""
+            names = " -> ".join(q.split(":", 1)[-1] for q in chain)
+            return f" (traced via {names})"
+
+        for qual, chain in reach.items():
+            entry = self.funcs.get(qual)
+            if entry is None:
+                continue
+            pf = entry.pf
+            fn = entry.node
+            declared: Set[str] = set()
+            for node in _walk_shallow(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    declared.update(node.names)
+            for node in _walk_shallow(fn):
+                # UC301: state mutation
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for target in targets:
+                        if (
+                            isinstance(target, ast.Name)
+                            and target.id in declared
+                        ):
+                            add(
+                                pf,
+                                node.lineno,
+                                "UC301",
+                                f"traced function {fn.name!r} rebinds "
+                                f"{target.id!r} via global/nonlocal — the "
+                                "mutation happens once at trace time, not "
+                                f"per call{via(chain)}",
+                            )
+                        elif isinstance(target, ast.Subscript) and isinstance(
+                            target.value, ast.Name
+                        ):
+                            base = target.value.id
+                            if base in self.module_globals.get(pf.norm, ()):
+                                add(
+                                    pf,
+                                    node.lineno,
+                                    "UC301",
+                                    f"traced function {fn.name!r} mutates "
+                                    f"module-level container {base!r} — "
+                                    "trace-time side effect"
+                                    f"{via(chain)}",
+                                )
+                elif isinstance(node, ast.Call):
+                    dn = dotted_name(node.func) or ""
+                    qualifier, name = call_name(node)
+                    # UC301: module container mutation via method
+                    if (
+                        name in _CONTAINER_MUTATORS
+                        and qualifier is not None
+                        and qualifier
+                        in self.module_globals.get(pf.norm, ())
+                    ):
+                        add(
+                            pf,
+                            node.lineno,
+                            "UC301",
+                            f"traced function {fn.name!r} mutates "
+                            f"module-level container {qualifier!r} via "
+                            f".{name}() — trace-time side effect"
+                            f"{via(chain)}",
+                        )
+                    # UC302: RNG / time
+                    if _RNG_TIME.match(dn) and not dn.startswith(
+                        "jax.random."
+                    ):
+                        add(
+                            pf,
+                            node.lineno,
+                            "UC302",
+                            f"traced function {fn.name!r} calls {dn}() — "
+                            "the value freezes into the compiled program; "
+                            "thread a jax.random key through instead"
+                            f"{via(chain)}",
+                        )
+                    # UC303: readback without annotation
+                    hit = self._readback(node)
+                    if hit is not None and node.lineno not in pf.readback_lines:
+                        add(
+                            pf,
+                            node.lineno,
+                            "UC303",
+                            f"traced function {fn.name!r} reads back "
+                            f"off-device via {hit} without a "
+                            f"'# readback: <why>' annotation{via(chain)}",
+                        )
+
+        # UC304: recompile hazards, repo-wide over device modules.
+        for pf in self.files:
+            if not _is_device_module(pf):
+                continue
+            self._check_recompile(pf, add)
+
+    @staticmethod
+    def _readback(call: ast.Call) -> Optional[str]:
+        qualifier, name = call_name(call)
+        if qualifier == "jax" and name == "device_get":
+            return "jax.device_get()"
+        if (
+            name == "item"
+            and isinstance(call.func, ast.Attribute)
+            and not call.args
+            and not call.keywords
+        ):
+            return f"{qualifier or '<expr>'}.item()"
+        if (
+            name == "asarray"
+            and qualifier in _NUMPY_QUALS
+            and qualifier != "jnp"
+            and not any(kw.arg == "dtype" for kw in call.keywords)
+        ):
+            return f"{qualifier}.asarray() without dtype="
+        return None
+
+    def _check_recompile(self, pf: ParsedFile, add) -> None:
+        # (a) a fresh traced callable built *and consumed* per call:
+        #     `jit(f)(x)` immediately invoked, or a jit/pallas_call
+        #     wrapping constructed inside a loop body.  The build-once
+        #     factory idiom — `return jax.jit(f)` / `self._fn = jit(f)`
+        #     — is the repo's standard caching pattern and is exempt:
+        #     the wrapper object survives, so the jit cache hits.
+        parents: Dict[int, ast.AST] = {}
+        loop_depth: Dict[int, int] = {}
+
+        def map_tree(node: ast.AST, depth: int) -> None:
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+                child_depth = depth
+                if isinstance(child, (ast.For, ast.While)):
+                    child_depth += 1
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    child_depth = 0  # a nested def resets the loop context
+                loop_depth[id(child)] = child_depth
+                map_tree(child, child_depth)
+
+        map_tree(pf.tree, 0)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tracer = _tracer_call(node)
+            if tracer is None:
+                continue
+            parent = parents.get(id(node))
+            invoked = isinstance(parent, ast.Call) and parent.func is node
+            in_loop = loop_depth.get(id(node), 0) > 0
+            if not invoked and not in_loop:
+                continue
+            operand = node.args[0] if node.args else None
+            label = (
+                "lambda ..."
+                if isinstance(operand, ast.Lambda)
+                else operand.id
+                if isinstance(operand, ast.Name)
+                else "..."
+            )
+            where = (
+                "is invoked immediately"
+                if invoked
+                else "is rebuilt inside a loop"
+            )
+            add(
+                pf,
+                node.lineno,
+                "UC304",
+                f"recompile hazard: {tracer}({label}) {where} — a fresh "
+                "traced callable per call means the jit cache never "
+                "hits; build once (module scope or cached attribute) "
+                "and reuse",
+            )
+        # (b) unhashable literal at a known static position.
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Name):
+                continue
+            positions = self.static_positions.get((pf.norm, node.func.id))
+            if not positions:
+                continue
+            for idx in positions:
+                if idx < len(node.args) and isinstance(
+                    node.args[idx], (ast.List, ast.Dict, ast.Set)
+                ):
+                    add(
+                        pf,
+                        node.lineno,
+                        "UC304",
+                        f"recompile hazard: call to jitted "
+                        f"{node.func.id!r} passes an unhashable "
+                        f"{type(node.args[idx]).__name__.lower()} literal "
+                        f"at static position {idx} — jit static args must "
+                        "hash; pass a tuple or hoist the constant",
+                    )
+
+
+def run_purity(files: List[ParsedFile]) -> Tuple[List[Diagnostic], Dict]:
+    p = PurityPass(files)
+    p.build()
+    p.find_entries()
+    p.check()
+    summary = {
+        "entries": sorted({f"{q} [{how}]" for q, how in p.entries}),
+        "reachable": len(p.reachable()),
+    }
+    return p.diagnostics, summary

@@ -510,7 +510,7 @@ class DecrementalTracer:
 
             self._unpack = unpack
         try:
-            return np.asarray(self._unpack(mark_w))
+            return np.asarray(self._unpack(mark_w))  # readback: host boundary: packed wake marks -> np for the caller
         except Exception:
             self.invalidate()
             raise

@@ -327,7 +327,7 @@ def marking_parents_jax(flags, recv_count, supervisor, edge_src, edge_dst,
     mark, parent = fn(
         flags, recv_count, supervisor, edge_src, edge_dst, edge_weight
     )
-    return np.asarray(mark), np.asarray(parent)
+    return np.asarray(mark), np.asarray(parent)  # readback: host boundary: device marks/parents -> np result contract
 
 
 def bits_at(table, ids, n, jnp):
@@ -1533,10 +1533,10 @@ def trace_marks_layouts(
     out = fn(flags[:n], recv_count[:n], *args)
     if with_stats:
         marks, stats = out
-        return np.asarray(marks), {
-            k: np.asarray(v) for k, v in stats.items()
+        return np.asarray(marks), {  # readback: host boundary: device marks -> np result contract
+            k: np.asarray(v) for k, v in stats.items()  # readback: host boundary: device stats -> np result contract
         }
-    return np.asarray(out)
+    return np.asarray(out)  # readback: host boundary: device marks -> np result contract
 
 
 def trace_marks_pallas(
@@ -1546,22 +1546,22 @@ def trace_marks_pallas(
     """Same contract as trace_marks_np/_jax, Pallas propagation inside."""
     n = flags.shape[0]
     prep = prepare_chunks(
-        np.asarray(edge_src),
-        np.asarray(edge_dst),
-        np.asarray(edge_weight),
-        np.asarray(supervisor),
+        np.asarray(edge_src),  # readback: host-side graph layout prep (inputs are host arrays)
+        np.asarray(edge_dst),  # readback: host-side graph layout prep (inputs are host arrays)
+        np.asarray(edge_weight),  # readback: host-side graph layout prep (inputs are host arrays)
+        np.asarray(supervisor),  # readback: host-side graph layout prep (inputs are host arrays)
         n,
     )
     jp = None
     if mode in (MODE_JUMP, MODE_AUTO):
         jp = jump_parents_from_graph(
-            np.asarray(edge_src),
-            np.asarray(edge_dst),
-            np.asarray(edge_weight),
-            np.asarray(supervisor),
+            np.asarray(edge_src),  # readback: host-side jump-parent prep (inputs are host arrays)
+            np.asarray(edge_dst),  # readback: host-side jump-parent prep (inputs are host arrays)
+            np.asarray(edge_weight),  # readback: host-side jump-parent prep (inputs are host arrays)
+            np.asarray(supervisor),  # readback: host-side jump-parent prep (inputs are host arrays)
             n,
         )
     return trace_marks_layouts(
-        np.asarray(flags), np.asarray(recv_count), [prep], mode=mode,
+        np.asarray(flags), np.asarray(recv_count), [prep], mode=mode,  # readback: host-side layout prep (inputs are host arrays)
         jump_parent=jp,
     )

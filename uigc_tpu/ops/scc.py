@@ -211,4 +211,4 @@ def scc_labels_jax(
         np.asarray(edge_dst, dtype=np.int32),
         np.asarray(active, dtype=bool),
     )
-    return np.asarray(out)
+    return np.asarray(out)  # readback: host boundary: device SCC labels -> np result contract

@@ -209,7 +209,7 @@ def trace_marks_jax(
     import numpy as _np
 
     out = fn(flags, recv_count, supervisor, edge_src, edge_dst, edge_weight)
-    return _np.asarray(out)
+    return _np.asarray(out)  # readback: host boundary: device marks -> np result contract
 
 
 def garbage_and_kills_np(

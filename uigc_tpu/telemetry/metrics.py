@@ -600,6 +600,42 @@ class EventMetricsBridge:
             "Foreign-owned boundary mirrors decayed out of the "
             "traversal working set (uigc.crgc.mirror-decay-waves).",
         )
+        self._link_heals = r.counter(
+            "uigc_link_heals_total",
+            "Previously-downed peers that rejoined and were revived "
+            "by the heartbeat monitor (heal or fresh incarnation).",
+        )
+        self._node_draining = r.counter(
+            "uigc_node_draining_total",
+            "Graceful-drain starts on this node (runtime/node.py "
+            "drain(): membership retracted, shards rebalancing).",
+        )
+        self._sbr_quarantines = r.counter(
+            "uigc_sbr_quarantine_total",
+            "Entries into split-brain quarantine (routing frozen, "
+            "journal checkpointed+frozen), by checkpointed.",
+        )
+        self._stale_windows = r.counter(
+            "uigc_stale_windows_total",
+            "Pre-death stragglers of a rejoined incarnation refused "
+            "by the undo-log fence (the latent (peer, fence) bug).",
+        )
+        self._delta_graph_bytes = r.histogram(
+            "uigc_delta_graph_bytes",
+            "Serialized delta-graph size shipped to the collector "
+            "(shadow entries + compression table).",
+            buckets=BYTES_BUCKETS,
+        )
+        self._ingress_entry_bytes = r.histogram(
+            "uigc_ingress_entry_bytes",
+            "Serialized ingress-entry size crossing the node boundary.",
+            buckets=BYTES_BUCKETS,
+        )
+        self._sanitizer_checks = r.counter(
+            "uigc_sanitizer_checks_total",
+            "uigcsan oracle cross-checks of the live collector, by "
+            "divergent (true = the oracle disagreed: a soundness bug).",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -751,6 +787,29 @@ class EventMetricsBridge:
                 self._dist_boundary_edges.set(edges)
         elif name == events.DIST_REFOLD:
             self._dist_refolds.inc()
+        elif name == events.LINK_HEALED:
+            self._link_heals.inc()
+        elif name == events.NODE_DRAINING:
+            self._node_draining.inc()
+        elif name == events.SBR_QUARANTINE:
+            self._sbr_quarantines.inc(
+                checkpointed=str(bool(fields.get("checkpointed"))).lower()
+            )
+        elif name == events.STALE_WINDOW:
+            self._stale_windows.inc(peer=fields.get("peer", "?"))
+        elif name == events.DELTA_GRAPH_SERIALIZATION:
+            size = fields.get("shadow_size", 0) + fields.get(
+                "compression_table_size", 0
+            )
+            if size:
+                self._delta_graph_bytes.observe(size)
+        elif name == events.INGRESS_ENTRY_SERIALIZATION:
+            size = fields.get("size")
+            if size is not None:
+                self._ingress_entry_bytes.observe(size)
+        elif name == events.ANALYSIS_CHECK:
+            divergent = fields.get("n_garbage") != fields.get("oracle_garbage")
+            self._sanitizer_checks.inc(divergent=str(divergent).lower())
 
 
 def _shadow_graph_size(system: Any) -> Optional[int]:
