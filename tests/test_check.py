@@ -6,8 +6,11 @@ Layers:
   undocumented/typo'd/dead config keys (UC101/UC108/UC102), an orphan
   frame kind (UC104), an untested wire decoder (UC105), a cross-module
   lock inversion with a witness path (UC201) and a blocking call under
-  a held lock (UC203), an impure traced function (UC301/UC302) and an
-  unhashable literal at a jit static position (UC304);
+  a held lock (UC203), an impure traced function (UC301/UC302), an
+  unhashable literal at a jit static position (UC304), and a pickle
+  call in gateway code — reachable from a client entry point (UC401)
+  or merely present there (UL016), each with a closed-codec clean
+  counterpart;
 - negatives: the repository itself is strict-clean (the acceptance
   gate), and ``# uigc-lint: disable=`` comments silence surface rules;
 - machinery: the refactored ``tools/uigc_lint.py`` wrapper and
@@ -159,6 +162,36 @@ def _mini_repo(root):
     )
     _plant(
         root,
+        "uigc_tpu/gateway/ingest.py",
+        '''\
+        import marshal
+        import pickle
+
+        from ..runtime import schema
+
+
+        def client_ingest(buf):
+            # Planted: a client-input entry point whose helper pickles.
+            return _hydrate(buf)
+
+
+        def _hydrate(buf):
+            return pickle.loads(buf)
+
+
+        def client_parse_ok(buf):
+            # Clean counterpart: the closed client codec fires nothing.
+            return schema.decode_client_value(buf)
+
+
+        def _archive_restore(blob):
+            # A gateway-side deserializer no client entry point reaches:
+            # UL016 territory, but not UC401.
+            return marshal.loads(blob)
+        ''',
+    )
+    _plant(
+        root,
         "uigc_tpu/ops/kernel.py",
         """\
         import time
@@ -285,6 +318,35 @@ def test_seeded_unhashable_static_arg(mini):
     assert "'tile'" in findings[0].message
     assert "list" in findings[0].message
     assert "static position 1" in findings[0].message
+
+
+def test_seeded_gateway_unsafe_deserializer_reachability(mini):
+    result = _check(mini, ["UC401"])
+    findings = _by_rule(result, "UC401")
+    assert len(findings) == 1
+    message = findings[0].message
+    assert "pickle.loads" in message
+    assert "via _hydrate" in message  # the transitive closure, not the entry
+    assert findings[0].path.endswith("gateway/ingest.py")
+    # marshal.loads also sits in gateway code but no client entry point
+    # reaches it: reachability, not mere presence, drives UC401.
+    assert "marshal" not in message
+
+
+def test_seeded_gateway_pickle_lint_both_directions(mini):
+    result = _check(mini, ["UL016"])
+    findings = _by_rule(result, "UL016")
+    # Presence, not reachability: both deserializer calls fire.
+    assert len(findings) == 2
+    rendered = "\n".join(d.render() for d in findings)
+    assert "pickle.loads()" in rendered
+    assert "marshal.loads()" in rendered
+    # The closed client codec is the sanctioned path and stays silent:
+    # findings anchor only at the two deserializer call sites, not at
+    # client_parse_ok's schema.decode_client_value line.
+    assert "ingest.py:13" in rendered  # pickle.loads in _hydrate
+    assert "ingest.py:24" in rendered  # marshal.loads in _archive_restore
+    assert "ingest.py:18" not in rendered  # the clean codec call
 
 
 def test_suppression_comment_silences_surface_rule(mini):

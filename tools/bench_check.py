@@ -145,6 +145,26 @@ FAMILIES: Dict[str, Tuple[str, List[Metric]]] = {
             Metric("locality.max_node_population_fraction", "ceiling", 0.70),
         ],
     ),
+    # Ingress gateway (tools/ingress_bench.py): the front door under a
+    # 10x-capacity overload storm plus a connection-scale phase.  The
+    # contract is asymmetric on purpose: ADMITTED traffic keeps its p99
+    # (absolute ceiling — the overload controller's whole point), SHED
+    # traffic gets a clean retryable ERROR (floor on the clean-shed
+    # fraction), and acked_then_lost is a hard zero from the debut
+    # round — an ACK the client never got the result for is a
+    # durability lie, not jitter.  Throughput/connection figures ride
+    # the usual wide trajectory bands.
+    "INGRESS": (
+        "BENCH_INGRESS_r*.json",
+        [
+            Metric("overload.admitted_p99_ms", "ceiling", 250.0),
+            Metric("overload.clean_shed_fraction", "floor", 0.95),
+            Metric("overload.acked_then_lost", "zero", 0.0),
+            Metric("overload.admitted_per_sec", "higher", 0.40),
+            Metric("connections.per_gateway", "floor", 500.0),
+            Metric("connections.connect_per_sec", "higher", 0.40),
+        ],
+    ),
     # Device plane (telemetry/device.py + tools/device_report.py): the
     # TPU-session artifacts gate the same figures the wake-budget
     # explainer decomposes.  Rounds that predate wake_chain_bench (or

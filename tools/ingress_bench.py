@@ -1,0 +1,504 @@
+"""Ingress bench: the gateway front door under a 10x overload storm.
+
+Two data nodes serve a sharded counter keyspace; one proxy-only
+gateway node terminates real TCP client connections and routes SENDs
+into the entity plane.  Two phases, printed as one JSON object:
+
+1. **connections** — connection scale: open several hundred concurrent
+   client connections (CONNECT -> AUTH_OK each) against one gateway,
+   report the peak concurrently-terminated count and the handshake
+   rate.  The selector-loop architecture is the thing under test: the
+   gateway must hold the whole set on a fixed thread budget.
+2. **overload** — the admission contract: with the per-tenant token
+   bucket defining admitted capacity (``uigc.gateway.tenant-msgs-per-
+   sec``), clients drive SEND traffic at ~10x that capacity.  The
+   asymmetric promise under storm:
+
+   - ADMITTED commands keep their latency: ack p50/p99 (ms);
+   - SHED commands get a clean, seq-addressed, retryable ERROR frame
+     (``clean_shed_fraction`` of all non-acked sends — no silent
+     drops, no torn frames, no closed-without-answer);
+   - ``acked_then_lost`` is a hard zero: after the storm every key is
+     probed and its entity count must cover every ACK the clients
+     recorded — an ACK for state the entity does not hold would be a
+     durability lie.
+
+Commit as ``BENCH_INGRESS_r{N}.json``; bench_check's INGRESS family
+gates admitted_p99_ms (absolute ceiling), clean_shed_fraction (floor),
+acked_then_lost (hard zero from the debut round), connections
+per_gateway (floor) and the throughput figures by trajectory.
+
+Usage: python tools/ingress_bench.py [--connections 600] [--seconds 4]
+       [--capacity 300] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import struct
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from uigc_tpu import ActorSystem, ClusterSharding, Entity  # noqa: E402
+from uigc_tpu.gateway import IngressGateway, protocol  # noqa: E402
+from uigc_tpu.runtime.node import NodeFabric  # noqa: E402
+from uigc_tpu.utils.validation import require  # noqa: E402
+
+_LEN = struct.Struct(">I")
+
+
+def base_config(capacity_msgs_per_sec: int) -> dict:
+    return {
+        "uigc.crgc.wakeup-interval": 50,
+        "uigc.crgc.egress-finalize-interval": 10,
+        "uigc.crgc.shadow-graph": "array",
+        "uigc.crgc.num-nodes": 3,
+        "uigc.cluster.tick-interval": 40,
+        "uigc.cluster.handoff-retry": 150,
+        "uigc.runtime.throughput": 256,
+        "uigc.node.max-batch-frames": 1024,
+        "uigc.node.writer-queue-limit": 32768,
+        # The admission plane under test: the token bucket IS the
+        # definition of admitted capacity the storm multiplies.
+        "uigc.gateway.tenant-msgs-per-sec": capacity_msgs_per_sec,
+        "uigc.gateway.tenant-max-connections": 4096,
+        "uigc.gateway.egress-queue-limit": 1024,
+        "uigc.gateway.reader-threads": 2,
+    }
+
+
+class CounterEntity(Entity):
+    """Counts gateway commands; the ACK result is the count AFTER the
+    apply, so a later probe can verify no acked increment vanished."""
+
+    def __init__(self, ctx, key, state):
+        super().__init__(ctx, key)
+        self.count = (state or {}).get("count", 0)
+
+    def receive(self, msg):
+        if isinstance(msg, tuple) and msg and msg[0] == "gw-cmd":
+            _kind, ref, seq, cmd = msg
+            if not (isinstance(cmd, dict) and cmd.get("probe")):
+                self.count += 1
+            ref.tell(("ack", seq, self.count))
+        return self
+
+    def snapshot_state(self):
+        return {"count": self.count}
+
+
+def counter_factory(ctx, key, state):
+    return CounterEntity(ctx, key, state)
+
+
+def percentile(samples, p):
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    idx = min(len(ordered) - 1, int(round(p / 100.0 * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def settle(predicate, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return predicate()
+
+
+# ------------------------------------------------------------------- #
+# Minimal raw-framing client
+# ------------------------------------------------------------------- #
+
+
+def _read_one_frame(sock: socket.socket, timeout_s: float = 10.0):
+    """Blocking read of exactly one raw frame -> (op, value)."""
+    sock.settimeout(timeout_s)
+    buf = b""
+    while len(buf) < 4:
+        chunk = sock.recv(4 - len(buf))
+        if not chunk:
+            raise ConnectionError("gateway closed during handshake")
+        buf += chunk
+    (n,) = _LEN.unpack(buf)
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            raise ConnectionError("gateway closed mid-frame")
+        body += chunk
+    return protocol.decode_frame_body(body)
+
+
+class BenchClient:
+    """One raw-TCP client connection with a background reader tallying
+    ACK latency and seq-addressed ERROR frames."""
+
+    def __init__(self, host: str, port: int, tenant: str = "bench"):
+        self.sock = socket.create_connection((host, port))
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.lock = threading.Lock()
+        self.sent_at = {}
+        self.acked = {}  # seq -> (result, latency_s)
+        self.errors = {}  # seq -> error code
+        self.anon_errors = []  # ERROR frames without a seq
+        self.closed = False
+        self.auth_ok = threading.Event()
+        self.reader = threading.Thread(target=self._read_loop, daemon=True)
+        self.reader.start()
+        self.sock.sendall(
+            protocol.encode_frame(
+                protocol.OP_CONNECT, {"tenant": tenant, "proto": 1}
+            )
+        )
+        require(
+            self.auth_ok.wait(10.0),
+            "bench.connect",
+            "gateway never answered CONNECT with AUTH_OK",
+        )
+
+    def _read_loop(self):
+        buf = bytearray()
+        sock = self.sock
+        while True:
+            try:
+                data = sock.recv(65536)
+            except OSError:
+                data = b""
+            if not data:
+                self.closed = True
+                return
+            buf += data
+            while len(buf) >= 4:
+                (n,) = _LEN.unpack_from(buf, 0)
+                if len(buf) < 4 + n:
+                    break
+                body = bytes(buf[4 : 4 + n])
+                del buf[: 4 + n]
+                op, value = protocol.decode_frame_body(body)
+                now = time.perf_counter()
+                if op == protocol.OP_AUTH_OK:
+                    self.auth_ok.set()
+                elif op == protocol.OP_ACK and isinstance(value, dict):
+                    seq = value.get("seq")
+                    with self.lock:
+                        t0 = self.sent_at.get(seq)
+                        self.acked[seq] = (
+                            value.get("result"),
+                            (now - t0) if t0 is not None else 0.0,
+                        )
+                elif op == protocol.OP_ERROR and isinstance(value, dict):
+                    with self.lock:
+                        if "seq" in value:
+                            self.errors[value["seq"]] = value.get("code")
+                        else:
+                            self.anon_errors.append(value.get("code"))
+
+    def send_cmd(self, seq: int, key: str, cmd) -> None:
+        frame = protocol.encode_frame(
+            protocol.OP_SEND,
+            {"seq": seq, "type": "counter", "key": key, "cmd": cmd},
+        )
+        with self.lock:
+            self.sent_at[seq] = time.perf_counter()
+        self.sock.sendall(frame)
+
+    def outstanding(self) -> int:
+        with self.lock:
+            return len(self.sent_at) - len(self.acked) - len(self.errors)
+
+    def close(self):
+        # shutdown() before close(): the reader thread blocks in recv()
+        # holding a reference to the fd, so a bare close() would defer
+        # the FIN until that thread drains -- which it never does, since
+        # it is waiting for the very FIN.  Shutdown sends it immediately.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------------- #
+# Phases
+# ------------------------------------------------------------------- #
+
+
+def connection_scale_phase(host: str, port: int, gateway, n_conns: int) -> dict:
+    """Open ``n_conns`` concurrent connections (full CONNECT->AUTH_OK
+    handshake each, no reader threads — the sockets just sit), then
+    report the gateway's peak terminated count."""
+    socks = []
+    connect_frame = protocol.encode_frame(
+        protocol.OP_CONNECT, {"tenant": "scale", "proto": 1}
+    )
+    t0 = time.perf_counter()
+    try:
+        for _ in range(n_conns):
+            sock = socket.create_connection((host, port))
+            sock.sendall(connect_frame)
+            op, _value = _read_one_frame(sock)
+            require(
+                op == protocol.OP_AUTH_OK,
+                "bench.scale",
+                f"expected AUTH_OK, got op {op}",
+            )
+            socks.append(sock)
+        elapsed = time.perf_counter() - t0
+        peak = gateway.connection_count()
+    finally:
+        for sock in socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
+    settle(lambda: gateway.connection_count() == 0, 15.0)
+    return {
+        "opened": len(socks),
+        "per_gateway": peak,
+        "seconds": elapsed,
+        "connect_per_sec": len(socks) / elapsed if elapsed > 0 else 0.0,
+    }
+
+
+def overload_phase(
+    host: str,
+    port: int,
+    gateway,
+    capacity: int,
+    seconds: float,
+    n_clients: int,
+    n_keys: int,
+) -> dict:
+    clients = [BenchClient(host, port) for _ in range(n_clients)]
+    keys = [f"k-{i}" for i in range(n_keys)]
+    target_rate = capacity * 10  # the 10x storm, all clients combined
+    per_client = max(1, target_rate // n_clients)
+    stop = threading.Event()
+    seq_base = 1_000_000
+
+    def storm(ci: int, client: BenchClient):
+        # Paced bursts: BURST sends, then sleep whatever keeps this
+        # client at its share of the 10x rate.
+        burst = 32
+        interval = burst / per_client
+        seq = seq_base * (ci + 1)
+        i = 0
+        while not stop.is_set():
+            t_burst = time.perf_counter()
+            try:
+                for _ in range(burst):
+                    client.send_cmd(seq, keys[(seq + ci) % n_keys], {"op": "inc"})
+                    seq += 1
+            except OSError:
+                return
+            i += 1
+            sleep_for = interval - (time.perf_counter() - t_burst)
+            if sleep_for > 0:
+                time.sleep(sleep_for)
+
+    threads = [
+        threading.Thread(target=storm, args=(ci, c), daemon=True)
+        for ci, c in enumerate(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    storm_s = time.perf_counter() - t0
+    # Drain: every in-flight send resolves to an ACK or an ERROR.
+    settle(lambda: all(c.outstanding() == 0 for c in clients), 15.0)
+
+    sent = sum(len(c.sent_at) for c in clients)
+    ack_entries = [
+        (seq, result, lat)
+        for c in clients
+        for seq, (result, lat) in c.acked.items()
+    ]
+    acked = len(ack_entries)
+    error_seqs = sum(len(c.errors) for c in clients)
+    unresolved = sum(c.outstanding() for c in clients)
+    shed = sent - acked
+    latencies = [lat for _seq, _result, lat in ack_entries]
+
+    # Max acked count per key: ACK results are the entity count after
+    # each apply, so the final probe must read >= the max acked value.
+    max_acked: dict = {}
+    for ci, client in enumerate(clients):
+        with client.lock:
+            items = list(client.acked.items())
+        for seq, (result, _lat) in items:
+            key = keys[(seq + ci) % n_keys]
+            if isinstance(result, int) and result > max_acked.get(key, 0):
+                max_acked[key] = result
+
+    # Probe every key through the same front door (quota refills at
+    # capacity/s, so retry through any rate-shed).
+    prober = clients[0]
+    probe_seq = 1
+    finals: dict = {}
+    deadline = time.monotonic() + 30.0
+    for key in keys:
+        while time.monotonic() < deadline:
+            seq = probe_seq
+            probe_seq += 1
+            prober.send_cmd(seq, key, {"probe": True})
+            settle(
+                lambda: seq in prober.acked or seq in prober.errors, 5.0
+            )
+            if seq in prober.acked:
+                finals[key] = prober.acked[seq][0]
+                break
+            time.sleep(0.2)  # rate-shed: wait for bucket refill
+    acked_then_lost = sum(
+        1
+        for key, high in max_acked.items()
+        if not isinstance(finals.get(key), int) or finals[key] < high
+    )
+
+    result = {
+        "capacity_msgs_per_sec": capacity,
+        "target_multiple": 10,
+        "clients": n_clients,
+        "keys": n_keys,
+        "seconds": storm_s,
+        "sent": sent,
+        "acked": acked,
+        "admitted_per_sec": acked / storm_s if storm_s > 0 else 0.0,
+        "admitted_p50_ms": percentile(latencies, 50) * 1e3,
+        "admitted_p99_ms": percentile(latencies, 99) * 1e3,
+        "shed": shed,
+        "clean_shed_errors": error_seqs,
+        "unresolved": unresolved,
+        "clean_shed_fraction": (error_seqs / shed) if shed else 1.0,
+        "keys_probed": len(finals),
+        "acked_then_lost": acked_then_lost,
+    }
+    for client in clients:
+        client.close()
+    settle(lambda: gateway.connection_count() == 0, 15.0)
+    return result
+
+
+# ------------------------------------------------------------------- #
+# Driver
+# ------------------------------------------------------------------- #
+
+
+class DataNode:
+    __slots__ = ("name", "fabric", "system", "cluster", "region", "port")
+
+    def __init__(self, name: str, config: dict):
+        self.name = name
+        self.fabric = NodeFabric()
+        self.system = ActorSystem(
+            None, name=name, config=config, fabric=self.fabric
+        )
+        self.port = self.fabric.listen()
+        self.cluster = ClusterSharding.attach(self.system)
+        self.region = self.cluster.start("counter", counter_factory)
+
+
+def run(n_conns: int, seconds: float, capacity: int) -> dict:
+    config = base_config(capacity)
+    nodes = [DataNode(f"ingress-data-{i}", config) for i in range(2)]
+    gw_fabric = NodeFabric()
+    gw_system = ActorSystem(
+        None, name="ingress-gw", config=config, fabric=gw_fabric
+    )
+    gw_fabric.listen()
+    gw_cluster = ClusterSharding.attach(gw_system, proxy_only=True)
+    gateway = IngressGateway(gw_system)
+    result: dict = {}
+    try:
+        nodes[0].fabric.connect("127.0.0.1", nodes[1].port)
+        gw_fabric.connect("127.0.0.1", nodes[0].port)
+        gw_fabric.connect("127.0.0.1", nodes[1].port)
+        require(
+            settle(
+                lambda: len(gw_cluster.members()) == 2
+                and all(len(n.cluster.members()) == 2 for n in nodes)
+            ),
+            "bench.membership",
+            "2 data nodes + proxy gateway never settled",
+        )
+        require(
+            settle(lambda: gw_cluster.home_of("k-0") is not None),
+            "bench.table",
+            "gateway never adopted a shard table",
+        )
+        client_port = gateway.listen()
+        result["connections"] = connection_scale_phase(
+            "127.0.0.1", client_port, gateway, n_conns
+        )
+        result["overload"] = overload_phase(
+            "127.0.0.1",
+            client_port,
+            gateway,
+            capacity,
+            seconds,
+            n_clients=4,
+            n_keys=32,
+        )
+        require(
+            result["overload"]["acked_then_lost"] == 0,
+            "bench.acked-lost",
+            "an acked command's state vanished",
+            overload=result["overload"],
+        )
+        result["gateway_stats"] = dict(gateway.stats)
+    finally:
+        gateway.close()
+        for sysm in [gw_system] + [n.system for n in nodes]:
+            try:
+                sysm.terminate(timeout_s=5.0)
+            except Exception:
+                pass
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--connections", type=int, default=600)
+    parser.add_argument(
+        "--seconds", type=float, default=4.0, help="overload storm duration"
+    )
+    parser.add_argument(
+        "--capacity",
+        type=int,
+        default=150,
+        help="admitted tenant msgs/sec; the storm drives 10x this.  "
+        "Keep it comfortably below the host's end-to-end entity "
+        "throughput: the bench's p99 band asserts that ADMITTED "
+        "traffic stays fast, which only holds when admission control "
+        "(this quota) keeps the offered load inside capacity — a "
+        "quota at or above capacity just moves the queue inside and "
+        "the tail measures backlog, not the gateway",
+    )
+    parser.add_argument(
+        "--smoke", action="store_true", help="quick gate (80 conns, 1s)"
+    )
+    args = parser.parse_args()
+    if args.smoke:
+        args.connections, args.seconds, args.capacity = 80, 1.0, 200
+    result = run(args.connections, args.seconds, args.capacity)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,18 @@ UL008  inspector-mutates-engine-state
     *enablement* is engine state and lives with the engine
     (``Telemetry.attach``, ``engines/crgc/collector.py``).
 
+UL016  pickle-in-gateway
+    A direct ``pickle.*``/``marshal.*`` serializer call anywhere under
+    ``uigc_tpu/gateway/``.  The ingress gateway sits on the untrusted
+    side of the trust boundary: client bytes must only ever meet the
+    closed client value codec (``schema.encode_client_value`` /
+    ``decode_client_value`` — no code loading, bounded depth/size),
+    and node-plane replies cross back through ``runtime/wire.py``
+    helpers.  A code-loading deserializer in gateway code is one
+    routing bug away from running on attacker-controlled bytes, so it
+    is banned outright there (the static half of uigc-check's UC401
+    reachability rule).
+
 Suppression
 ===========
 

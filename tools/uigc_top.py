@@ -399,6 +399,22 @@ def render_dashboard(
         ),
         show_at_zero=("uigc_dist_boundary_edges",),
     )
+    # Ingress-gateway plane (uigc_tpu/gateway): the front door — live
+    # connections and egress depth shown even at zero so an attached
+    # but idle gateway is visible.
+    metric_row(
+        "ingress gateway",
+        (
+            ("uigc_gateway_connections", "conns"),
+            ("uigc_gateway_tenant_msgs_total", "msgs"),
+            ("uigc_gateway_shed_total", "shed"),
+            ("uigc_gateway_egress_queue_depth", "egress-depth"),
+        ),
+        show_at_zero=(
+            "uigc_gateway_connections",
+            "uigc_gateway_egress_queue_depth",
+        ),
+    )
     lines.append("")
     lines.extend(render_device_panel(device))
     firing = (alerts or {}).get("firing", [])

@@ -1,4 +1,4 @@
-"""UL001-UL015: the uigc-lint rule set as a pass over the shared parse.
+"""UL001-UL016: the uigc-lint rule set as a pass over the shared parse.
 
 Ported verbatim from ``tools/uigc_lint.py`` (which is now a thin
 wrapper over this module): rule ids, message texts, suppression
@@ -38,6 +38,8 @@ RULES = {
     "UL015": "dmark/dmack payload built outside the schema-codec "
     "helpers (no ad-hoc frames or JSON coordinate lists on the "
     "distributed hot path)",
+    "UL016": "pickle/marshal call inside the ingress gateway (client "
+    "bytes meet only the closed client value codec)",
 }
 
 _QUEUE_ATTR = re.compile(
@@ -152,7 +154,8 @@ class FileLinter:
         norm = self.pf.norm
         pickle_guarded = in_runtime and not norm.endswith("runtime/wire.py")
         device_plane = bool({"engines", "ops", "parallel"} & set(parts))
-        bounded_plane = in_runtime or "cluster" in parts
+        gateway_plane = "gateway" in parts
+        bounded_plane = in_runtime or bool({"cluster", "gateway"} & set(parts))
         fence_plane = bounded_plane and not (
             norm.endswith("cluster/sharding.py")
             or norm.endswith("cluster/journal.py")
@@ -177,6 +180,8 @@ class FileLinter:
                     self._lint_proxycell(node)
                 if pickle_guarded:
                     self._lint_pickle_hot_path(node)
+                if gateway_plane:
+                    self._lint_gateway_codec(node)
                 if device_plane:
                     self._lint_host_transfer(node)
                 if fence_plane:
@@ -637,6 +642,27 @@ class FileLinter:
                 "route through wire.encode_message_schema / "
                 "wire.decode_message (pickle is the sanctioned fallback "
                 "inside runtime/wire.py only)",
+            )
+
+    def _lint_gateway_codec(self, call: ast.Call) -> None:
+        """UL016: no pickle/marshal anywhere under uigc_tpu/gateway/ —
+        gateway modules sit on the untrusted side of the trust boundary
+        and client bytes must only meet the closed client value codec
+        (runtime/schema.py).  Node-plane replies cross back through
+        runtime/wire.py helpers, never a local deserializer call."""
+        qual, name = call_name(call)
+        if (qual == "pickle" and name in _PICKLE_CALLS) or (
+            qual == "marshal" and name in ("dumps", "loads", "dump", "load")
+        ):
+            self.add(
+                call.lineno,
+                "UL016",
+                f"direct {qual}.{name}() inside the ingress gateway; "
+                "client-plane values go through "
+                "schema.encode_client_value / decode_client_value and "
+                "node-plane replies through runtime/wire.py — a "
+                "code-loading deserializer here is one bug away from "
+                "attacker bytes",
             )
 
     def _lint_proxycell(self, call: ast.Call) -> None:

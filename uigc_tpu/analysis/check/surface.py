@@ -30,6 +30,10 @@ UC108  config key read via a literal that is not in DEFAULTS — the
        typo class (the read raises KeyError at runtime, or silently
        diverges from the documented surface when a local default is
        supplied)
+UC401  a pickle-class deserializer (pickle/marshal/wire.decode_message)
+       reachable from the ingress gateway's client-input entry points —
+       untrusted client bytes must only ever meet the closed client
+       value codec (runtime/schema.py), never a code-loading decoder
 
 The registry document (``--registry-out``) is versioned and
 shape-stable; ``tests/test_check.py`` pins the schema.
@@ -61,6 +65,7 @@ RULES = {
     "UC106": "CONFIG.md drifted from the harvested config surface",
     "UC107": "metric registered but never updated, sampled, nor referenced",
     "UC108": "config key read but absent from config DEFAULTS (typo class)",
+    "UC401": "unsafe deserializer reachable from gateway client-input paths",
 }
 
 REGISTRY_VERSION = 1
@@ -799,6 +804,78 @@ def run_surface(
                     f"wire decoder {name}() has no test reference — its "
                     "malformed-input (-> None) tolerance contract is "
                     "unpinned",
+                )
+
+    # ---- gateway client-input plane --------------------------------- #
+    # UC401: unsafe deserializers reachable from the gateway's
+    # client-input entry points.  Entry points are every function in
+    # the client protocol module (gateway/protocol.py parses raw socket
+    # bytes) plus any gateway function named client_*/_client_* (the
+    # helpers that touch pre-auth input).  Reachability is a transitive
+    # closure over callee NAMES — a deliberate over-approximation: a
+    # false edge costs one review, a missed edge ships pickle.loads on
+    # attacker bytes.  wire.decode_message counts as a sink here too:
+    # it is the trusted NODE-plane codec (pickle under a persistent-id
+    # allowlist) and must never see client bytes.
+    gateway_files = [
+        pf
+        for pf in files
+        if not pf.in_tests
+        and "/gateway/" in "/" + pf.norm.replace("\\", "/")
+    ]
+    if gateway_files:
+        gw_defs: Dict[str, List[Tuple[ParsedFile, ast.AST]]] = {}
+        for pf in gateway_files:
+            for node in ast.walk(pf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    gw_defs.setdefault(node.name, []).append((pf, node))
+        entries: Set[str] = set()
+        for fn_name, sites in gw_defs.items():
+            if fn_name.startswith(("client_", "_client_")):
+                entries.add(fn_name)
+            for pf, _node in sites:
+                if pf.endswith("gateway/protocol.py"):
+                    entries.add(fn_name)
+        gw_calls: Dict[str, Set[str]] = {}
+        gw_sinks: Dict[str, List[Tuple[str, str]]] = {}
+        for fn_name, sites in gw_defs.items():
+            for pf, fnode in sites:
+                for call in ast.walk(fnode):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    qual, cname = call_name(call)
+                    if not cname:
+                        continue
+                    if cname in gw_defs:
+                        gw_calls.setdefault(fn_name, set()).add(cname)
+                    unsafe = (
+                        (qual == "pickle" and cname in ("loads", "load", "Unpickler"))
+                        or (qual == "marshal" and cname in ("loads", "load"))
+                        or cname == "decode_message"
+                    )
+                    if unsafe:
+                        label = f"{qual}.{cname}" if qual else cname
+                        gw_sinks.setdefault(fn_name, []).append(
+                            (_site(pf, call.lineno), label)
+                        )
+        reached: Set[str] = set()
+        frontier = sorted(entries)
+        while frontier:
+            fn_name = frontier.pop()
+            if fn_name in reached:
+                continue
+            reached.add(fn_name)
+            frontier.extend(gw_calls.get(fn_name, ()))
+        for fn_name in sorted(reached):
+            for sink_site, sink in gw_sinks.get(fn_name, []):
+                add(
+                    sink_site,
+                    "UC401",
+                    f"{sink}() is reachable from gateway client-input "
+                    f"entry points (via {fn_name}) — untrusted client "
+                    "bytes must only meet the closed client value codec "
+                    "(runtime/schema.py), never a code-loading "
+                    "deserializer",
                 )
 
     return out, registry, status

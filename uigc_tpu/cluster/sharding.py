@@ -956,6 +956,14 @@ class _CodecFacade:
         self.systems = (
             fabric.systems if fabric is not None else {system.address: system}
         )
+        # Reply handles that ride cluster payloads (gateway ClientRefs)
+        # re-bind to their decode context and later *send* through it,
+        # so the facade must also stand in for the fabric's transport
+        # face.  Plain attributes, not methods: on a transport-less
+        # fabric ``send_frame`` is None and the handle's local-delivery
+        # fallback (via ``systems``) kicks in.
+        self.address = getattr(fabric, "address", None) or system.address
+        self.send_frame = getattr(fabric, "send_frame", None)
 
     def resolve_cell_token(self, address: str, uid: int):
         hook = getattr(self._fabric, "resolve_cell_token", None)
@@ -983,10 +991,21 @@ class ClusterSharding:
     ``Fabric`` (direct peer-region hand-off, same codec discipline),
     and fabric-less single systems (everything local)."""
 
-    def __init__(self, system: "ActorSystem", num_shards: Optional[int] = None):
+    def __init__(
+        self,
+        system: "ActorSystem",
+        num_shards: Optional[int] = None,
+        proxy_only: bool = False,
+    ):
         config = system.config
         self.system = system
         self.address = system.address
+        #: a proxy-only member (an ingress gateway) participates in
+        #: membership, gossip and routing but NEVER owns shards: it
+        #: joins permanently draining with an empty member view, so its
+        #: seed table is vacuous and every peer that links up is told
+        #: "sleave" before it can assign shards here (``_member_up``).
+        self.proxy_only = proxy_only
         self.num_shards = num_shards or config.get_int("uigc.cluster.num-shards")
         self.passivate_after_s = config.get_int("uigc.cluster.passivate-after") / 1000.0
         self.tick_s = config.get_int("uigc.cluster.tick-interval") / 1000.0
@@ -1073,7 +1092,7 @@ class ClusterSharding:
 
         self._lock = threading.RLock()
         self._regions: Dict[str, ShardRegion] = {}
-        self._members: set = {self.address}
+        self._members: set = set() if proxy_only else {self.address}
         self._table = ShardTable(0, self.address, {})
         self._name_seq = itertools.count(1)
         #: routes that could not be sent (no link yet / table vacuum /
@@ -1107,8 +1126,9 @@ class ClusterSharding:
         self._leaving: set = set()
         #: this node is draining: it excludes itself from placement,
         #: rebroadcasts its departure every tick, and refuses to
-        #: re-adopt shards a stale peer table hands back.
-        self._draining = False
+        #: re-adopt shards a stale peer table hands back.  A proxy-only
+        #: member is BORN draining — same machinery, permanent state.
+        self._draining = proxy_only
         self._closed = False
         self._ticks = 0
         #: last table version rebroadcast by the anti-entropy gossip
@@ -1147,9 +1167,12 @@ class ClusterSharding:
 
     @classmethod
     def attach(
-        cls, system: "ActorSystem", num_shards: Optional[int] = None
+        cls,
+        system: "ActorSystem",
+        num_shards: Optional[int] = None,
+        proxy_only: bool = False,
     ) -> "ClusterSharding":
-        sharding = cls(system, num_shards)
+        sharding = cls(system, num_shards, proxy_only=proxy_only)
         system.cluster = sharding
         return sharding
 
@@ -1401,6 +1424,18 @@ class ClusterSharding:
                 with self._lock:
                     self._pending_rejoin.add(address)
                 return
+        if self._draining:
+            if address == self.address:
+                # The fabric's subscribe replay includes ourselves; a
+                # draining (or proxy-only) member must never re-enter
+                # its own placement view — re-adding self here would
+                # recompute a table claiming the whole keyspace.
+                return
+            # A draining (or proxy-only) node tells every NEW link its
+            # departure immediately: without this, the peer's MemberUp
+            # adds us to its view and it may assign shards here during
+            # the window before the tick's sleave re-broadcast lands.
+            self._send_frame(address, wire.encode_shard_leave(self.address))
         with self._lock:
             self._leaving.discard(address)
             if address in self._members:

@@ -408,6 +408,10 @@ DEFAULTS: Dict[str, Any] = {
     # Backpressure-rate (fabric.backpressure events/s over the rule
     # window) above which the backpressure_spike alert fires.
     "uigc.telemetry.alert-backpressure-rate": 5.0,
+    # Shed-rate (gateway.shed events/s over the rule window) above
+    # which the gateway_overload alert fires — sustained shedding means
+    # the edge is refusing real traffic, not absorbing a blip.
+    "uigc.telemetry.alert-shed-rate": 10.0,
     # --- Host runtime settings (no reference analogue; ours) ---
     # Number of dispatcher worker threads.
     "uigc.runtime.num-workers": 4,
@@ -435,6 +439,42 @@ DEFAULTS: Dict[str, Any] = {
     "uigc.runtime.overflow-policy": "block",
     # Upper bound on one blocked send, in milliseconds.
     "uigc.runtime.mailbox-block-ms": 2000,
+    # --- Ingress gateway (uigc_tpu/gateway) ---
+    # Hard cap on concurrent client connections one gateway holds;
+    # accepts past it are closed immediately (shed{reason=conn-limit}).
+    "uigc.gateway.max-connections": 65536,
+    # Per-tenant concurrent connection quota; 0 = unlimited.
+    "uigc.gateway.tenant-max-connections": 1024,
+    # Per-tenant admitted commands per second (token bucket, burst ==
+    # one second of budget); 0 = unlimited.  Excess commands get a
+    # clean ERROR{msg-rate, retry_after_ms}.
+    "uigc.gateway.tenant-msgs-per-sec": 0,
+    # Static token table as "token=tenant[,token=tenant...]"; empty
+    # runs the gateway open (every CONNECT admitted, tenant taken from
+    # the CONNECT frame).
+    "uigc.gateway.auth-tokens": "",
+    # Per-connection egress queue bound, in frames.  Past half of it
+    # the connection's reads throttle; at the bound the connection is
+    # closed as a slow consumer — an unread reply queue must never
+    # balloon gateway memory.
+    "uigc.gateway.egress-queue-limit": 256,
+    # Largest client frame body accepted, in bytes; larger frames are
+    # a protocol violation (the connection is shed and closed).
+    "uigc.gateway.max-frame-bytes": 1048576,
+    # Admitted-traffic p99 latency band, in milliseconds (decode to
+    # routed): above it the overload controller sheds NEW work with
+    # ERROR{overload, retry_after_ms} until p99 falls to 80% of the
+    # band.  0 disables the latency trigger.
+    "uigc.gateway.overload-p99-ms": 250.0,
+    # Fabric writer-queue depth band: above it the overload controller
+    # sheds new work AND per-connection reads throttle (the one-hop
+    # extension of the PR 12 backpressure plane); exit at half.
+    # 0 disables the depth trigger.
+    "uigc.gateway.overload-queue-depth": 4096,
+    # The retry_after_ms hint stamped on every shed ERROR frame.
+    "uigc.gateway.shed-retry-after-ms": 1000,
+    # Selector reader threads; each owns conn_id % N of the sockets.
+    "uigc.gateway.reader-threads": 2,
 }
 
 

@@ -66,6 +66,8 @@ import zlib
 from collections import Counter
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
+from ..utils.validation import require
+
 DELIVER = "deliver"
 DROP = "drop"
 DUPLICATE = "duplicate"
@@ -74,6 +76,16 @@ DELAY = "delay"
 TRUNCATE = "truncate"
 
 _ACTIONS = (DROP, DUPLICATE, REORDER, DELAY, TRUNCATE)
+
+#: Client-socket fault kinds (the gateway edge, uigc_tpu/gateway).
+#: These model CLIENTS misbehaving, not links: the gateway's listener
+#: and reader loops consult them via :meth:`FaultPlan.client_accept`
+#: and :meth:`FaultPlan.client_inbound`.
+SLOWLORIS = "slowloris"  # byte-trickle: the reader sees ~1 byte/round
+HALF_OPEN = "half-open"  # bytes vanish, the socket never EOFs
+FLOOD = "flood"  # connect flood: accept then slam the door
+
+_CLIENT_KINDS = (SLOWLORIS, HALF_OPEN, TRUNCATE, FLOOD)
 
 
 class _Rule:
@@ -131,6 +143,12 @@ class FaultPlan:
         #: thread perturbs determinism
         self._heals: List[tuple] = []
         self._crash_at: Dict[str, int] = {}
+        #: client-socket fault rules (gateway edge); src = the gateway
+        #: address ("*" = any), kind = one of _CLIENT_KINDS
+        self._client_rules: List[_Rule] = []
+        #: sticky per-connection verdicts: a slowloris client stays a
+        #: slowloris for the life of its connection
+        self._client_verdicts: Dict[Tuple[str, int], str] = {}
         #: address -> [appends_remaining, keep_bytes, keep_fraction]
         #: for the torn-journal-append injection (crash-at-byte)
         self._journal_crash: Dict[str, list] = {}
@@ -276,6 +294,85 @@ class FaultPlan:
                 keep_fraction,
             ]
         return self
+
+    def client_fault(
+        self,
+        kind: str,
+        gateway: str = "*",
+        prob: float = 1.0,
+        count: Optional[int] = None,
+    ) -> "FaultPlan":
+        """Arm one client-socket fault unit at the gateway edge:
+
+        - ``SLOWLORIS``: the connection trickles — the reader loop
+          processes at most one byte of it per select round, so frames
+          take hundreds of rounds to complete (the classic
+          hold-a-worker-hostage attack; a selector-based reader must
+          not care).
+        - ``HALF_OPEN``: the client vanished without FIN — its bytes
+          stop being delivered but the socket never EOFs, so only
+          idle/liveness accounting can reclaim it.
+        - ``TRUNCATE``: the connection dies mid-frame — half the
+          current read chunk arrives, then EOF.
+        - ``FLOOD``: a connect flood — matched accepts are slammed shut
+          before admission (the listener's cheap first line of
+          defense); the gateway accounts them as ``shed{reason=flood}``.
+
+        Verdicts are sticky per connection (drawn once, on the first
+        inbound query) and deterministic in (seed, gateway, conn_id)."""
+        require(
+            kind in _CLIENT_KINDS,
+            "fault.client_kind",
+            f"unknown client fault kind {kind!r}",
+        )
+        with self._lock:
+            self._client_rules.append(
+                _Rule(kind, gateway, "*", kind, prob, count, None)
+            )
+        return self
+
+    def client_accept(self, gateway: str, accept_seq: int) -> str:
+        """Accept-time verdict for the ``accept_seq``-th connection the
+        gateway's listener took: DELIVER, or DROP for a matched connect
+        flood (close before admission)."""
+        with self._lock:
+            rng = self._rng(gateway, "client-accept")
+            for rule in self._client_rules:
+                if rule.kind != FLOOD or not rule.applies(gateway, "*", FLOOD):
+                    continue
+                if rule.prob < 1.0 and rng.random() >= rule.prob:
+                    continue
+                if rule.count is not None:
+                    rule.count -= 1
+                self.stats[("client-flood", gateway, "")] += 1
+                return DROP
+        return DELIVER
+
+    def client_inbound(self, gateway: str, conn_id: int) -> str:
+        """Sticky read-path verdict for one client connection:
+        DELIVER, SLOWLORIS, HALF_OPEN or TRUNCATE.  Drawn once per
+        connection from the (seed, gateway, conn_id) RNG stream."""
+        key = (gateway, conn_id)
+        with self._lock:
+            verdict = self._client_verdicts.get(key)
+            if verdict is not None:
+                return verdict
+            verdict = DELIVER
+            rng = self._rng(gateway, f"client-{conn_id}")
+            for rule in self._client_rules:
+                if rule.kind == FLOOD or not rule.applies(
+                    gateway, "*", rule.kind
+                ):
+                    continue
+                if rule.prob < 1.0 and rng.random() >= rule.prob:
+                    continue
+                if rule.count is not None:
+                    rule.count -= 1
+                verdict = rule.action
+                self.stats[("client-" + verdict, gateway, "")] += 1
+                break
+            self._client_verdicts[key] = verdict
+            return verdict
 
     # ------------------------------------------------------------- #
     # Fabric-facing queries

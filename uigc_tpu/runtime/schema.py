@@ -392,6 +392,189 @@ def decode_keyset_any(data: bytes) -> Optional[List[Tuple[str, int]]]:
             return None
     return keys
 
+# ------------------------------------------------------------------- #
+# Client value codec (the gateway's untrusted-byte value plane)
+#
+# The node-plane value codec above is marshal — fine between handshaken
+# peers, never acceptable on bytes from a client socket: marshal.loads
+# on attacker input can crash the interpreter.  Client frame bodies
+# therefore ride this hand-written tagged encoding instead, decoded by
+# pure Python index arithmetic that can only ever raise
+# :class:`ClientDecodeError`:
+#
+#   value := 'N' | 'T' | 'F'                      (None / True / False)
+#          | 'i' varint(zigzag(v))                (int, arbitrary size)
+#          | 'f' 8-byte big-endian IEEE double    (float)
+#          | 's' varint(len) utf8-bytes           (str)
+#          | 'b' varint(len) raw-bytes            (bytes)
+#          | 'l' varint(count) value*             (list)
+#          | 'd' varint(count) (value value)*     (dict)
+#
+# Depth is capped at 16 (mirroring :func:`value_safe`), container
+# counts are sanity-bounded by the remaining byte budget (each element
+# costs >= 1 byte, so a count larger than what is left is malformed by
+# construction — no attacker-controlled giant preallocation), and int
+# varints are capped at 10 bytes.  Tuples encode as lists: the client
+# plane has no tuple/list distinction.
+# ------------------------------------------------------------------- #
+
+#: Client frames above this decoded-container depth are malformed.
+CLIENT_MAX_DEPTH = 16
+
+#: Longest accepted int varint (70 bits pre-zigzag: covers int64 with
+#: headroom; anything longer is a resource-exhaustion probe).
+_CLIENT_MAX_INT_BYTES = 10
+
+
+class ClientDecodeError(ValueError):
+    """A client frame body failed to decode.  The ONLY exception the
+    client value plane raises on arbitrary input — callers turn it into
+    a protocol ERROR frame, never a connection-thread crash."""
+
+
+def encode_client_value(value: Any, _depth: int = 0) -> bytes:
+    """Encode a tree of plain values for the client wire (format block
+    above).  Raises ``TypeError`` on non-value types — the gateway only
+    ever encodes trees it built itself."""
+    parts: List[bytes] = []
+    _put_client_value(parts, value, _depth)
+    return b"".join(parts)
+
+
+def _put_client_value(parts: List[bytes], value: Any, depth: int) -> None:
+    if value is None:
+        parts.append(b"N")
+        return
+    t = type(value)
+    if t is bool:
+        parts.append(b"T" if value else b"F")
+    elif t is int:
+        zz = _zigzag(value)
+        if zz.bit_length() > 7 * _CLIENT_MAX_INT_BYTES:
+            raise TypeError("client value int out of range")
+        parts.append(b"i")
+        _put_varint(parts, zz)
+    elif t is float:
+        parts.append(b"f")
+        parts.append(struct.pack(">d", value))
+    elif t is str:
+        raw = value.encode()
+        parts.append(b"s")
+        _put_varint(parts, len(raw))
+        parts.append(raw)
+    elif t is bytes:
+        parts.append(b"b")
+        _put_varint(parts, len(value))
+        parts.append(value)
+    elif t is list or t is tuple:
+        if depth >= CLIENT_MAX_DEPTH:
+            raise TypeError("client value tree too deep")
+        parts.append(b"l")
+        _put_varint(parts, len(value))
+        for item in value:
+            _put_client_value(parts, item, depth + 1)
+    elif t is dict:
+        if depth >= CLIENT_MAX_DEPTH:
+            raise TypeError("client value tree too deep")
+        parts.append(b"d")
+        _put_varint(parts, len(value))
+        for k, v in value.items():
+            _put_client_value(parts, k, depth + 1)
+            _put_client_value(parts, v, depth + 1)
+    else:
+        raise TypeError(f"client value plane cannot encode {t.__name__}")
+
+
+def _zigzag(value: int) -> int:
+    return -2 * value - 1 if value < 0 else value << 1
+
+
+def decode_client_value(data: bytes) -> Any:
+    """Decode one client value; raises :class:`ClientDecodeError` on
+    any malformation, including trailing bytes."""
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise ClientDecodeError("client body is not bytes")
+    data = bytes(data)
+    try:
+        value, off = _get_client_value(data, 0, 0)
+    except (IndexError, struct.error, UnicodeDecodeError) as exc:
+        raise ClientDecodeError(f"malformed client value: {exc}") from None
+    if off != len(data):
+        raise ClientDecodeError("trailing bytes after client value")
+    return value
+
+
+def _get_client_varint(data: bytes, off: int) -> Tuple[int, int]:
+    # _get_varint with a length cap: unbounded continuation bytes are
+    # an attacker-controlled big-int allocation.
+    result = shift = n = 0
+    while True:
+        b = data[off]
+        off += 1
+        n += 1
+        if n > _CLIENT_MAX_INT_BYTES:
+            raise ClientDecodeError("client varint too long")
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _get_client_value(data: bytes, off: int, depth: int) -> Tuple[Any, int]:
+    if depth > CLIENT_MAX_DEPTH:
+        raise ClientDecodeError("client value tree too deep")
+    tag = data[off : off + 1]
+    if not tag:
+        raise ClientDecodeError("truncated client value")
+    off += 1
+    if tag == b"N":
+        return None, off
+    if tag == b"T":
+        return True, off
+    if tag == b"F":
+        return False, off
+    if tag == b"i":
+        zz, off = _get_client_varint(data, off)
+        return (zz >> 1) ^ -(zz & 1), off
+    if tag == b"f":
+        (value,) = struct.unpack_from(">d", data, off)
+        return value, off + 8
+    if tag == b"s":
+        n, off = _get_client_varint(data, off)
+        raw = data[off : off + n]
+        if len(raw) != n:
+            raise ClientDecodeError("truncated client string")
+        return raw.decode(), off + n
+    if tag == b"b":
+        n, off = _get_client_varint(data, off)
+        raw = data[off : off + n]
+        if len(raw) != n:
+            raise ClientDecodeError("truncated client bytes")
+        return raw, off + n
+    if tag == b"l":
+        count, off = _get_client_varint(data, off)
+        if count > len(data) - off:
+            raise ClientDecodeError("client list count exceeds body")
+        items = []
+        for _ in range(count):
+            item, off = _get_client_value(data, off, depth + 1)
+            items.append(item)
+        return items, off
+    if tag == b"d":
+        count, off = _get_client_varint(data, off)
+        if count * 2 > len(data) - off:
+            raise ClientDecodeError("client dict count exceeds body")
+        out = {}
+        for _ in range(count):
+            k, off = _get_client_value(data, off, depth + 1)
+            if not isinstance(k, (str, int, bool, float, bytes, type(None))):
+                raise ClientDecodeError("unhashable client dict key")
+            v, off = _get_client_value(data, off, depth + 1)
+            out[k] = v
+        return out, off
+    raise ClientDecodeError(f"unknown client value tag {tag!r}")
+
+
 _APP_HDR = struct.Struct(">qBH")  # (window_id, flags, n_refs)
 
 _CRGC_CLASSES: Optional[tuple] = None

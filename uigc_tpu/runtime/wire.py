@@ -84,6 +84,18 @@ def _entity_ref_class():
     return _ENTITY_REF
 
 
+_CLIENT_REF = None
+
+
+def _client_ref_class():
+    global _CLIENT_REF
+    if _CLIENT_REF is None:
+        from ..gateway.session import ClientRef
+
+        _CLIENT_REF = ClientRef
+    return _CLIENT_REF
+
+
 _REF_CLASSES = None
 
 
@@ -124,6 +136,17 @@ class _Pickler(pickle.Pickler):
             # node's shard region — never to a concrete cell, which may
             # passivate or migrate while the message is in flight.
             pid = ("entity", obj.type_name, obj.key)
+        elif (
+            type(obj).__name__ == "ClientRef"
+            and hasattr(obj, "gateway_address")
+            and hasattr(obj, "conn_id")
+        ):
+            # Duck-typed so pickling ordinary traffic never imports the
+            # gateway package: a client reply handle crosses as its
+            # (gateway, connection) coordinates and re-binds to the
+            # receiving node's fabric — the reply frame finds its way
+            # back to the one gateway that owns the socket.
+            pid = ("gwclient", obj.gateway_address, obj.conn_id)
         elif isinstance(obj, CrgcRefob):
             t = obj._target
             return ("refob", t.system.address, t.uid)
@@ -168,6 +191,9 @@ class _Unpickler(pickle.Unpickler):
                     "attached to the receiving system"
                 )
             return cluster.entity_ref(type_name, key)
+        if pid[0] == "gwclient":
+            _, address, conn_id = pid
+            return _client_ref_class()(address, conn_id, self._fabric)
         kind, address, uid = pid
         cell = _resolve(self._fabric, address, uid)
         if kind == "refob":
@@ -748,6 +774,42 @@ def decode_ts_response(frame: tuple):
         if not isinstance(payload, bytes):
             return None
         return int(req_id), str(origin), payload
+    except (IndexError, TypeError, ValueError):
+        return None
+
+
+# ------------------------------------------------------------------- #
+# Ingress-gateway reply frames (uigc_tpu/gateway)
+#
+# The return hop of the client plane: an entity anywhere in the cluster
+# tells a ClientRef, and the message crosses the node fabric back to
+# the gateway that owns the socket as ONE frame kind:
+#
+#   ("gwr", conn_id, payload)   deliver to connection conn_id
+#
+# ``payload`` is node-plane message bytes (encode_message — trusted
+# pickle/schema between handshaken cluster members, the SAME trust
+# domain as every frame above; client-plane re-encoding to the
+# untrusted socket happens inside the gateway over the client value
+# codec).  Tolerance contract as above: trailing elements accepted,
+# malformed -> None, unknown kind ignored by old peers after seq
+# accounting — a gateway-less build simply never registers the handler.
+# ------------------------------------------------------------------- #
+
+GATEWAY_FRAME_KIND = "gwr"
+
+
+def encode_gateway_reply(conn_id: int, payload: bytes) -> tuple:
+    return ("gwr", int(conn_id), payload)
+
+
+def decode_gateway_reply(frame: tuple):
+    """-> (conn_id, payload_bytes) or None."""
+    try:
+        payload = frame[2]
+        if not isinstance(payload, bytes):
+            return None
+        return int(frame[1]), payload
     except (IndexError, TypeError, ValueError):
         return None
 

@@ -456,4 +456,17 @@ def builtin_rules(config: Any) -> List[AlertRule]:
             "cluster buffers) are overflowing faster than the tolerated "
             "rate — a consumer is saturated or a node is wedged",
         ),
+        AlertRule(
+            "gateway_overload",
+            "uigc_gateway_shed_total",
+            "rate",
+            severity="warning",
+            op=">",
+            value=config.get_float("uigc.telemetry.alert-shed-rate"),
+            window_s=30.0,
+            description="the ingress gateway is shedding client traffic "
+            "faster than the tolerated rate — admitted-traffic p99 or "
+            "writer-queue depth crossed the overload bands, or tenants "
+            "are blowing their quotas (uigc_tpu/gateway)",
+        ),
     ]

@@ -636,6 +636,17 @@ class EventMetricsBridge:
             "uigcsan oracle cross-checks of the live collector, by "
             "divergent (true = the oracle disagreed: a soundness bug).",
         )
+        self._gw_tenant_msgs = r.counter(
+            "uigc_gateway_tenant_msgs_total",
+            "Client commands admitted through the ingress gateway and "
+            "routed into the entity plane, by tenant.",
+        )
+        self._gw_shed = r.counter(
+            "uigc_gateway_shed_total",
+            "Client work the gateway refused with a clean ERROR frame "
+            "or a slammed socket (overload / quotas / auth / protocol "
+            "violations / slow consumers), by reason.",
+        )
 
     def __call__(self, name: str, fields: Dict[str, Any]) -> None:
         if self.node is not None:
@@ -752,6 +763,16 @@ class EventMetricsBridge:
             )
         elif name == events.SHARD_BUFFER_DROPPED:
             self._entity_buffer_dropped.inc(site=fields.get("site", "?"))
+        elif name == events.GATEWAY_MSG:
+            self._gw_tenant_msgs.inc(
+                fields.get("count", 1) or 1,
+                tenant=fields.get("tenant", "?"),
+            )
+        elif name == events.GATEWAY_SHED:
+            self._gw_shed.inc(
+                fields.get("count", 1) or 1,
+                reason=fields.get("reason", "?"),
+            )
         elif name == events.JOURNAL_TORN:
             self._journal_torn.inc()
         elif name == events.JOURNAL_RECOVERED:
@@ -927,6 +948,26 @@ def install_system_gauges(registry: MetricsRegistry, system: Any) -> None:
         "Open + retained journal segment files on this node.",
         fn=lambda: _cluster_stat(system, "journal_segments"),
     )
+    # Ingress-gateway gauges (uigc_tpu/gateway): lazy reads of
+    # ``system.gateway``, same late-attach contract as the cluster —
+    # None until a gateway exists on this node.
+    registry.gauge(
+        "uigc_gateway_connections",
+        "Client connections this gateway currently terminates.",
+        fn=lambda: _gateway_stat(system, "connections"),
+    )
+    registry.gauge(
+        "uigc_gateway_egress_queue_depth",
+        "Reply frames queued across all per-connection egress queues.",
+        fn=lambda: _gateway_stat(system, "egress_depth"),
+    )
+
+
+def _gateway_stat(system: Any, field: str) -> Optional[float]:
+    gateway = getattr(system, "gateway", None)
+    if gateway is None:
+        return None
+    return gateway.gauge_value(field)
 
 
 def _link_phis(system: Any) -> Optional[Dict[str, float]]:

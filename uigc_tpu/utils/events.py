@@ -252,6 +252,26 @@ SHARD_BUFFER_DROPPED = "shard.buffer_dropped"
 NODE_DRAINING = "fabric.node_draining"
 NODE_DRAINED = "fabric.node_drained"
 
+# Ingress-gateway events (uigc_tpu/gateway, the client edge):
+#   gateway.connection      one client connection changed state (fields:
+#                           action="open"|"close"|"reject", tenant) —
+#                           feeds the uigc_gateway_connections gauge's
+#                           churn context
+#   gateway.msg             admitted client commands routed into the
+#                           entity plane (fields: tenant, count) —
+#                           uigc_gateway_tenant_msgs_total{tenant}
+#   gateway.shed            client work refused with a clean ERROR
+#                           frame or a slammed socket (fields:
+#                           reason="overload"|"auth"|"conn-limit"|
+#                           "msg-rate"|"draining"|"proto"|"slow-consumer"|
+#                           "flood"|"gone"|"encode", count) —
+#                           uigc_gateway_shed_total{reason}; read
+#                           throttling itself rides fabric.backpressure
+#                           with site="gateway"
+GATEWAY_CONNECTION = "gateway.connection"
+GATEWAY_MSG = "gateway.msg"
+GATEWAY_SHED = "gateway.shed"
+
 # Partition-tolerance events (uigc_tpu/cluster/membership.py + the
 # epoch-fencing sites, PR 13):
 #   cluster.sbr_decision      the split-brain resolver reached a verdict
